@@ -1,0 +1,10 @@
+"""Cross-cutting runtime configuration (dtype policy, env flags).
+
+Reference analog: ND4J's runtime-flag tier — org.nd4j.config.ND4JSystemProperties /
+ND4JEnvironmentVars and libnd4j's Environment singleton.
+"""
+
+from deeplearning4j_tpu.common.dtypes import DtypePolicy, get_policy, set_policy
+from deeplearning4j_tpu.common.env import Environment, env
+
+__all__ = ["DtypePolicy", "get_policy", "set_policy", "Environment", "env"]

@@ -1,0 +1,64 @@
+"""Global dtype policy: params in f32, compute in bf16 on the MXU.
+
+Reference analog: ND4J's global data-type setting
+(org.nd4j.linalg.factory.Nd4j#setDefaultDataTypes, DataType.HALF on GPU) and
+libnd4j Environment::allowHalfPrecision. On TPU the idiomatic split is
+mixed precision: keep master params + optimizer state in float32, run
+matmul/conv compute in bfloat16 (native MXU dtype, no loss-scaling needed
+unlike fp16), and accumulate in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    """What dtype each tensor class uses.
+
+    param_dtype:   master copy of trainable parameters (and optimizer state).
+    compute_dtype: activations / matmul inputs inside the jitted step.
+    output_dtype:  dtype returned to the user from ``output()`` etc.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def mixed(self) -> bool:
+        return self.compute_dtype != self.param_dtype
+
+    def cast_to_compute(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+
+FLOAT32 = DtypePolicy()
+BF16 = DtypePolicy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+
+_policy: DtypePolicy = FLOAT32
+
+
+def set_policy(policy: DtypePolicy | str) -> DtypePolicy:
+    """Set the process-wide dtype policy ("float32", "bf16", or a DtypePolicy)."""
+    global _policy
+    if isinstance(policy, str):
+        policy = {"float32": FLOAT32, "f32": FLOAT32, "bf16": BF16, "bfloat16": BF16}[
+            policy.lower()
+        ]
+    _policy = policy
+    return _policy
+
+
+def get_policy() -> DtypePolicy:
+    return _policy

@@ -1,0 +1,48 @@
+"""Runtime environment flags, read from process env vars.
+
+Reference analog: org.nd4j.config.ND4JEnvironmentVars (backend selection,
+workspace debug, OMP threads) and libnd4j's Environment singleton
+(verbose/debug toggles over JNI). Here the flags steer op-impl selection
+(Pallas vs plain XLA), debug checks, and profiling — the things that still
+exist in an XLA world.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+class Environment:
+    """Process-wide runtime switches (singleton, like libnd4j Environment)."""
+
+    # Disable all Pallas kernels: every op uses its plain-XLA lowering.
+    # Analog of removing deeplearning4j-cuda from the classpath (no cuDNN helpers).
+    DISABLE_PALLAS = "DL4J_TPU_DISABLE_PALLAS"
+    # Force Pallas kernels even where the predicate would pick XLA (testing).
+    FORCE_PALLAS = "DL4J_TPU_FORCE_PALLAS"
+    # Panic on NaN/Inf produced by ops (OpProfiler ANY_PANIC analog).
+    NAN_PANIC = "DL4J_TPU_NAN_PANIC"
+    # Verbose op-dispatch logging (libnd4j Environment::setVerbose analog).
+    VERBOSE = "DL4J_TPU_VERBOSE"
+    # Per-op timing profiler (org.nd4j.linalg.profiler.OpProfiler analog).
+    PROFILING = "DL4J_TPU_PROFILING"
+
+    def __init__(self) -> None:
+        self.reload()
+
+    def reload(self) -> None:
+        self.disable_pallas = _flag(self.DISABLE_PALLAS)
+        self.force_pallas = _flag(self.FORCE_PALLAS)
+        self.nan_panic = _flag(self.NAN_PANIC)
+        self.verbose = _flag(self.VERBOSE)
+        self.profiling = _flag(self.PROFILING)
+
+
+env = Environment()

@@ -1,0 +1,20 @@
+"""Named-op layer: registry + implementations.
+
+Reference analog: libnd4j's declarable-op catalog
+(libnd4j/include/ops/declarable/generic/**) with its platform-helper
+override mechanism (libnd4j/include/ops/declarable/platform/{cudnn,mkldnn}).
+Here every op has a plain-XLA lowering and may register a Pallas kernel that
+is chosen at call time by a predicate on shapes/dtypes — cuDNN-vs-generic
+selection re-created TPU-natively.
+"""
+
+from deeplearning4j_tpu.ops.registry import (
+    OpImpl,
+    get_op,
+    op,
+    register_impl,
+    register_op,
+)
+from deeplearning4j_tpu.ops import activations, losses  # noqa: F401  (populate registries)
+
+__all__ = ["OpImpl", "get_op", "op", "register_impl", "register_op"]

@@ -1,0 +1,74 @@
+"""Activation catalog, name-addressable.
+
+Reference analog: nd4j-api :: org.nd4j.linalg.activations.Activation enum and
+its IActivation impls (ActivationReLU, ActivationCube, ActivationRationalTanh,
+...). DL4J activations are strings in layer JSON; we keep that contract so
+configs round-trip. All are plain jnp — XLA fuses them into adjacent
+matmuls/convs, so none need Pallas.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _rational_tanh(x):
+    # DL4J ActivationRationalTanh: fast tanh approximation
+    # f(x) = 1.7159 * tanh_approx(2x/3) with tanh_approx rational.
+    a = 1.7159
+    y = (2.0 / 3.0) * x
+    yabs = jnp.abs(y)
+    approx = jnp.sign(y) * (1.0 - 1.0 / (1.0 + yabs + y * y + 1.41645 * y**4))
+    return a * approx
+
+
+def _rectified_tanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+ACTIVATIONS: dict[str, Callable] = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": jax.nn.hard_tanh,
+    "rationaltanh": _rational_tanh,
+    "rectifiedtanh": _rectified_tanh,
+    "softmax": jax.nn.softmax,
+    "logsoftmax": jax.nn.log_softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": lambda x: x**3,
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get_activation(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower().replace("_", "")
+    if key not in ACTIVATIONS:
+        raise ValueError(f"unknown activation '{name_or_fn}'; known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]
+
+
+def activation_name(fn_or_name) -> str:
+    if isinstance(fn_or_name, str):
+        return fn_or_name.lower().replace("_", "")
+    for k, v in ACTIVATIONS.items():
+        if v is fn_or_name:
+            return k
+    raise ValueError("cannot serialize custom activation function to JSON")

@@ -1,0 +1,141 @@
+"""Loss-function catalog, name-addressable.
+
+Reference analog: nd4j-api :: org.nd4j.linalg.lossfunctions.LossFunctions
+(LossFunction enum: MCXENT, XENT, MSE, L1, L2, NEGATIVELOGLIKELIHOOD, HINGE,
+SQUARED_HINGE, KL_DIVERGENCE, POISSON, COSINE_PROXIMITY, MEAN_ABSOLUTE_
+PERCENTAGE_ERROR, MEAN_SQUARED_LOGARITHMIC_ERROR) and the ILossFunction
+impls. Each takes (labels, preactivations-after-activation, mask) and returns
+per-example scores; reduction to scalar happens in the training loop so
+masking and per-output weighting compose.
+
+All losses operate on the *activated* output (DL4J computes activation inside
+the output layer); numerically-fused paths (softmax+CE, sigmoid+BCE) are used
+when the caller passes logits with ``from_logits=True``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _reduce(per_elem, mask):
+    """Sum over output dims -> per-example score; apply mask if given."""
+    score = per_elem.reshape(per_elem.shape[0], -1).sum(axis=-1)
+    if mask is not None:
+        score = score * mask.reshape(mask.shape[0], -1).squeeze()
+    return score
+
+
+def mcxent(labels, output, mask=None, from_logits=False):
+    """Multi-class cross entropy (DL4J MCXENT / NEGATIVELOGLIKELIHOOD)."""
+    if from_logits:
+        logp = jax.nn.log_softmax(output, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(output, _EPS, 1.0))
+    per = -(labels * logp)
+    if mask is not None and mask.ndim == per.ndim:
+        per = per * mask
+        mask = None
+    return _reduce(per, mask)
+
+
+def xent(labels, output, mask=None, from_logits=False):
+    """Binary cross entropy (DL4J XENT)."""
+    if from_logits:
+        per = jnp.maximum(output, 0) - output * labels + jnp.log1p(jnp.exp(-jnp.abs(output)))
+    else:
+        p = jnp.clip(output, _EPS, 1.0 - _EPS)
+        per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    return _reduce(per, mask)
+
+
+def mse(labels, output, mask=None, **_):
+    d = output - labels
+    per = d * d
+    # DL4J MSE averages over the output dimension (LossMSE = LossL2 / nOut)
+    return _reduce(per, mask) / output.shape[-1]
+
+
+def l2(labels, output, mask=None, **_):
+    d = output - labels
+    return _reduce(d * d, mask)
+
+
+def mae(labels, output, mask=None, **_):
+    return _reduce(jnp.abs(output - labels), mask) / output.shape[-1]
+
+
+def l1(labels, output, mask=None, **_):
+    return _reduce(jnp.abs(output - labels), mask)
+
+
+def hinge(labels, output, mask=None, **_):
+    # labels in {-1, +1} (DL4J LossHinge)
+    return _reduce(jnp.maximum(0.0, 1.0 - labels * output), mask)
+
+
+def squared_hinge(labels, output, mask=None, **_):
+    h = jnp.maximum(0.0, 1.0 - labels * output)
+    return _reduce(h * h, mask)
+
+
+def kld(labels, output, mask=None, **_):
+    y = jnp.clip(labels, _EPS, 1.0)
+    p = jnp.clip(output, _EPS, 1.0)
+    return _reduce(y * (jnp.log(y) - jnp.log(p)), mask)
+
+
+def poisson(labels, output, mask=None, **_):
+    return _reduce(output - labels * jnp.log(jnp.clip(output, _EPS, None)), mask)
+
+
+def cosine_proximity(labels, output, mask=None, **_):
+    yn = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + _EPS)
+    pn = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + _EPS)
+    per = -(yn * pn)
+    return _reduce(per, mask)
+
+
+def mape(labels, output, mask=None, **_):
+    per = jnp.abs((labels - output) / jnp.clip(jnp.abs(labels), _EPS, None)) * 100.0
+    return _reduce(per, mask) / output.shape[-1]
+
+
+def msle(labels, output, mask=None, **_):
+    d = jnp.log1p(jnp.clip(output, _EPS - 1, None)) - jnp.log1p(jnp.clip(labels, _EPS - 1, None))
+    return _reduce(d * d, mask) / output.shape[-1]
+
+
+LOSSES: dict[str, Callable] = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": mcxent,
+    "xent": xent,
+    "mse": mse,
+    "l2": l2,
+    "l1": l1,
+    "mae": mae,
+    "hinge": hinge,
+    "squaredhinge": squared_hinge,
+    "kldivergence": kld,
+    "kld": kld,
+    "poisson": poisson,
+    "cosineproximity": cosine_proximity,
+    "meanabsolutepercentageerror": mape,
+    "mape": mape,
+    "meansquaredlogarithmicerror": msle,
+    "msle": msle,
+}
+
+
+def get_loss(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower().replace("_", "")
+    if key not in LOSSES:
+        raise ValueError(f"unknown loss '{name_or_fn}'; known: {sorted(LOSSES)}")
+    return LOSSES[key]

@@ -1,0 +1,53 @@
+"""Seeded RNG utilities.
+
+Reference analog: ND4J's RNG (org.nd4j.linalg.api.rng.DefaultRandom backed by
+libnd4j's Philox-style NativeRandom, seeded via Nd4j.getRandom().setSeed).
+JAX's counter-based threefry/rbg keys give the same property the reference
+engineered for — identical streams on host and device — for free. We keep a
+small stateful wrapper so imperative call-sites (dropout at layer level,
+iterators) have the DL4J ergonomics while jitted code uses explicit keys.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomProvider:
+    """Stateful key holder; ``split()`` hands out fresh subkeys."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    def set_seed(self, seed: int) -> None:
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def split(self, n: int = 1):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return keys[1] if n == 1 else keys[1:]
+
+    # Convenience samplers mirroring Nd4j.rand / Nd4j.randn
+    def uniform(self, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+        return jax.random.uniform(self.split(), shape, dtype, minval, maxval)
+
+    def normal(self, shape, dtype=jnp.float32):
+        return jax.random.normal(self.split(), shape, dtype)
+
+    def bernoulli(self, p, shape):
+        return jax.random.bernoulli(self.split(), p, shape)
+
+
+_default = RandomProvider(0)
+
+
+def get_random() -> RandomProvider:
+    """Nd4j.getRandom() analog."""
+    return _default

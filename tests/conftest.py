@@ -1,0 +1,25 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Reference analog of two tricks at once (SURVEY.md §4): DL4J's
+backend-parameterized suites (same tests on nd4j-native and nd4j-cuda) and
+ParallelWrapper's threads-as-devices tests. JAX gives both via
+--xla_force_host_platform_device_count: the identical pjit/shard_map code
+that runs on a real v5e mesh runs here on 8 virtual CPU devices.
+
+Must run before jax is imported anywhere, hence top of conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

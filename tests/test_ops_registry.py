@@ -1,0 +1,90 @@
+"""Op registry: platform-helper style selection (SURVEY.md §2.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.env import env
+from deeplearning4j_tpu.ops import registry
+from deeplearning4j_tpu.ops.activations import ACTIVATIONS, get_activation
+from deeplearning4j_tpu.ops.losses import LOSSES, get_loss
+
+
+def test_xla_impl_is_default():
+    opname = "_test_double"
+
+    @registry.register_op(opname)
+    def _double(x):
+        return x * 2
+
+    out = registry.op(opname)(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+
+
+def test_accelerated_impl_selected_by_predicate():
+    opname = "_test_scale"
+
+    @registry.register_op(opname)
+    def _xla(x):
+        return x * 2
+
+    @registry.register_impl(opname, predicate=lambda x: x.shape[0] >= 4)
+    def _pallas(x):
+        return x * 3
+
+    small = registry.op(opname)(jnp.ones((2,)))
+    big = registry.op(opname)(jnp.ones((4,)))
+    assert float(small[0]) == 2.0  # predicate rejects -> xla
+    assert float(big[0]) == 3.0  # predicate accepts -> accelerated
+
+
+def test_disable_pallas_env_flag(monkeypatch):
+    opname = "_test_flagged"
+
+    @registry.register_op(opname)
+    def _xla(x):
+        return x + 1
+
+    @registry.register_impl(opname)
+    def _pallas(x):
+        return x + 100
+
+    assert float(registry.op(opname)(jnp.zeros(()))) == 100.0
+    env.disable_pallas = True
+    try:
+        assert float(registry.op(opname)(jnp.zeros(()))) == 1.0
+    finally:
+        env.disable_pallas = False
+
+
+@pytest.mark.parametrize("name", sorted(ACTIVATIONS))
+def test_activations_finite(name):
+    x = jnp.linspace(-3, 3, 32).reshape(4, 8)
+    y = get_activation(name)(x)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+@pytest.mark.parametrize("name", sorted(LOSSES))
+def test_losses_shapes(name):
+    n, k = 6, 5
+    rng = np.random.default_rng(0)
+    labels = jnp.asarray(rng.random((n, k)), jnp.float32)
+    probs = jnp.asarray(rng.random((n, k)) * 0.9 + 0.05, jnp.float32)
+    probs = probs / probs.sum(-1, keepdims=True)
+    if name in ("hinge", "squaredhinge"):
+        labels = jnp.sign(labels - 0.5)
+    score = get_loss(name)(labels, probs)
+    assert score.shape == (n,)
+    assert bool(jnp.all(jnp.isfinite(score)))
+
+
+def test_softmax_ce_from_logits_matches_probs():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(4, 7)), jnp.float32)
+    labels = jnp.eye(7)[jnp.asarray([0, 3, 6, 2])]
+    import jax
+
+    a = get_loss("mcxent")(labels, jax.nn.softmax(logits), from_logits=False)
+    b = get_loss("mcxent")(labels, logits, from_logits=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4)
