@@ -1,0 +1,15 @@
+"""Evaluation metrics.
+
+Reference analog: org.nd4j.evaluation — Evaluation (classification), ROC /
+ROCMultiClass / ROCBinary, RegressionEvaluation, EvaluationBinary,
+ConfusionMatrix.
+"""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix, EvaluationBinary
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+
+__all__ = [
+    "Evaluation", "ConfusionMatrix", "EvaluationBinary",
+    "RegressionEvaluation", "ROC", "ROCMultiClass",
+]

@@ -1,0 +1,194 @@
+"""Classification evaluation.
+
+Reference analog: org.nd4j.evaluation.classification.Evaluation — accuracy,
+per-class precision/recall/F1 (+ macro/micro averages), confusion matrix,
+top-N accuracy, Matthews correlation; org.nd4j.evaluation.classification.
+EvaluationBinary for per-output binary metrics.
+
+Accumulation is streaming (eval(labels, predictions) per batch) exactly like
+the reference; the per-batch reduction to a confusion matrix runs on device,
+only the small [C, C] matrix syncs to host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """org.nd4j.evaluation.classification.ConfusionMatrix analog."""
+
+    def __init__(self, n_classes: int):
+        self.n_classes = n_classes
+        self.matrix = np.zeros((n_classes, n_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def add_matrix(self, m):
+        self.matrix += np.asarray(m, dtype=np.int64)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+def _to_class_indices(a, n_classes=None):
+    a = np.asarray(a)
+    if a.ndim >= 2 and a.shape[-1] > 1:
+        return np.argmax(a, axis=-1).reshape(-1)
+    return a.reshape(-1).astype(np.int64)
+
+
+class Evaluation:
+    """Streaming multi-class evaluation (org.nd4j.evaluation.classification.Evaluation)."""
+
+    def __init__(self, n_classes: Optional[int] = None, labels: Optional[list] = None):
+        self.labels = labels
+        self.n_classes = n_classes or (len(labels) if labels else None)
+        self.cm: Optional[ConfusionMatrix] = None
+        self._topn_correct = 0
+        self._topn_total = 0
+        self.top_n = 1
+
+    def _ensure(self, n):
+        if self.cm is None:
+            self.n_classes = self.n_classes or n
+            self.cm = ConfusionMatrix(self.n_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """Accumulate a batch. labels/predictions: one-hot/prob [B, C] (or [B,T,C] with mask)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # time series: flatten with mask
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+            else:
+                m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
+            labels = labels.reshape(-1, labels.shape[-1])[m]
+            predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        elif mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        n = labels.shape[-1] if labels.ndim >= 2 else int(max(labels.max(), predictions.max()) + 1)
+        self._ensure(n)
+        actual = _to_class_indices(labels)
+        # top-N bookkeeping needs the probability matrix
+        if predictions.ndim >= 2 and predictions.shape[-1] > 1 and self.top_n > 1:
+            order = np.argsort(-predictions, axis=-1)[:, : self.top_n]
+            self._topn_correct += int((order == actual[:, None]).any(axis=1).sum())
+            self._topn_total += len(actual)
+        pred = _to_class_indices(predictions)
+        np.add.at(self.cm.matrix, (actual, pred), 1)
+
+    # ---- metrics ----
+    @property
+    def _m(self):
+        if self.cm is None:
+            raise ValueError("no batches evaluated")
+        return self.cm.matrix
+
+    def num_examples(self) -> int:
+        return int(self._m.sum())
+
+    def accuracy(self) -> float:
+        m = self._m
+        tot = m.sum()
+        return float(np.trace(m) / tot) if tot else 0.0
+
+    def top_n_accuracy(self) -> float:
+        return self._topn_correct / self._topn_total if self._topn_total else 0.0
+
+    def true_positives(self, c: int) -> int:
+        return int(self._m[c, c])
+
+    def false_positives(self, c: int) -> int:
+        return int(self._m[:, c].sum() - self._m[c, c])
+
+    def false_negatives(self, c: int) -> int:
+        return int(self._m[c, :].sum() - self._m[c, c])
+
+    def precision(self, c: Optional[int] = None) -> float:
+        if c is not None:
+            tp, fp = self.true_positives(c), self.false_positives(c)
+            return tp / (tp + fp) if tp + fp else 0.0
+        vals = [self.precision(i) for i in range(self.n_classes)
+                if self._m[:, i].sum() + self._m[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, c: Optional[int] = None) -> float:
+        if c is not None:
+            tp, fn = self.true_positives(c), self.false_negatives(c)
+            return tp / (tp + fn) if tp + fn else 0.0
+        vals = [self.recall(i) for i in range(self.n_classes)
+                if self._m[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, c: Optional[int] = None) -> float:
+        p, r = self.precision(c), self.recall(c)
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    def matthews_correlation(self, c: int) -> float:
+        tp = self.true_positives(c)
+        fp = self.false_positives(c)
+        fn = self.false_negatives(c)
+        tn = self.num_examples() - tp - fp - fn
+        denom = np.sqrt(float(tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return float((tp * tn - fp * fn) / denom) if denom else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            f"# of classes: {self.n_classes}",
+            f"Examples: {self.num_examples()}",
+            f"Accuracy: {self.accuracy():.4f}",
+            f"Precision: {self.precision():.4f}",
+            f"Recall: {self.recall():.4f}",
+            f"F1: {self.f1():.4f}",
+            "",
+            "Confusion matrix (rows=actual, cols=predicted):",
+            str(self.cm),
+        ]
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output binary metrics (org.nd4j.evaluation.classification.EvaluationBinary)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels).reshape(-1, np.asarray(labels).shape[-1])
+        preds = (np.asarray(predictions).reshape(labels.shape) >= self.threshold)
+        lab = labels >= 0.5
+        if self.tp is None:
+            n = labels.shape[-1]
+            self.tp = np.zeros(n, np.int64)
+            self.fp = np.zeros(n, np.int64)
+            self.tn = np.zeros(n, np.int64)
+            self.fn = np.zeros(n, np.int64)
+        self.tp += (preds & lab).sum(0)
+        self.fp += (preds & ~lab).sum(0)
+        self.tn += (~preds & ~lab).sum(0)
+        self.fn += (~preds & lab).sum(0)
+
+    def accuracy(self, i: int) -> float:
+        tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float((self.tp[i] + self.tn[i]) / tot) if tot else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if p + r else 0.0

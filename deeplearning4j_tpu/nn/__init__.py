@@ -1,0 +1,27 @@
+"""Neural-network library: declarative configs + two model classes.
+
+Reference analog: deeplearning4j-nn (org.deeplearning4j.nn.conf.**,
+org.deeplearning4j.nn.layers.**, org.deeplearning4j.nn.multilayer.MultiLayerNetwork,
+org.deeplearning4j.nn.graph.ComputationGraph). TPU-first redesign: layer
+configs are frozen dataclasses that both declare hyperparameters (JSON
+round-trippable like DL4J's Jackson configs) and provide pure functional
+``init``/``apply`` — so a whole model traces into one XLA program.
+"""
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.builders import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+__all__ = [
+    "InputType",
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ComputationGraphConfiguration",
+    "MultiLayerNetwork",
+    "ComputationGraph",
+]

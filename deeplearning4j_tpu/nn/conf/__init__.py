@@ -1,0 +1,6 @@
+"""Declarative network configuration.
+
+Reference analog: deeplearning4j-nn :: org.deeplearning4j.nn.conf.** —
+NeuralNetConfiguration builders, layer configs, graph-vertex configs, and
+InputType shape inference (org.deeplearning4j.nn.conf.inputs.InputType).
+"""

@@ -1,0 +1,153 @@
+"""Input preprocessors — reshape adapters between layer families.
+
+Reference analog: org.deeplearning4j.nn.conf.preprocessor.{CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor,
+CnnToRnnPreProcessor, RnnToCnnPreProcessor}. MultiLayerConfiguration inserts
+these automatically from InputType inference, as in DL4J's
+setInputType/getPreProcessorForInputType.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+PREPROC_REGISTRY: dict[str, type] = {}
+
+
+def _register(cls):
+    PREPROC_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class InputPreProcessor:
+    def __call__(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, itype: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d = {k: (list(v) if isinstance(v, tuple) else v) for k, v in d.items()}
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = PREPROC_REGISTRY[d.pop("@type")]
+        return cls(**{k: tuple(v) if isinstance(v, list) else v for k, v in d.items()})
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class FlattenPreProcessor(InputPreProcessor):
+    """CNN [B,H,W,C] (or any rank) -> FF [B, H*W*C] (CnnToFeedForwardPreProcessor)."""
+
+    def __call__(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, itype):
+        return InputType.feed_forward(itype.size)
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class ReshapeToCnnPreProcessor(InputPreProcessor):
+    """FF [B, H*W*C] -> CNN [B,H,W,C] NHWC (FeedForwardToCnnPreProcessor).
+
+    Also accepts NCHW [B,C,H,W] arrays and transposes — the DL4J-data boundary.
+    """
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 4:
+            if x.shape[1:] == (self.height, self.width, self.channels):
+                return x
+            if x.shape[1:] == (self.channels, self.height, self.width):
+                return x.transpose(0, 2, 3, 1)  # NCHW -> NHWC once, at the boundary
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, itype):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[B,T,F] -> [B*T,F] (RnnToFeedForwardPreProcessor)."""
+
+    def __call__(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, itype):
+        return InputType.feed_forward(itype.shape[1])
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[B*T,F] -> [B,T,F]; needs timesteps known at trace time."""
+
+    timesteps: int = 0
+
+    def __call__(self, x, mask=None):
+        return x.reshape(-1, self.timesteps, x.shape[-1])
+
+    def output_type(self, itype):
+        return InputType.recurrent(itype.size, self.timesteps)
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[B,H,W,C] -> [B, H, W*C] treating height as time (CnnToRnnPreProcessor)."""
+
+    def __call__(self, x, mask=None):
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c)
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        return InputType.recurrent(w * c, h)
+
+
+def auto_preprocessor(prev: InputType, layer) -> InputPreProcessor | None:
+    """Pick the DL4J-standard preprocessor between ``prev`` and ``layer``'s family."""
+    from deeplearning4j_tpu.nn.layers import conv as convmod
+    from deeplearning4j_tpu.nn.layers import recurrent as recmod
+    from deeplearning4j_tpu.nn.layers import attention as attmod
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, EmbeddingSequenceLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+
+    cnn_layers = (convmod.ConvolutionLayer, convmod.SubsamplingLayer,
+                  convmod.Deconvolution2DLayer, convmod.SeparableConvolution2DLayer,
+                  convmod.DepthwiseConvolution2DLayer, convmod.Upsampling2DLayer,
+                  convmod.Cropping2DLayer, convmod.ZeroPadding2DLayer,
+                  convmod.SpaceToDepthLayer, convmod.LocalResponseNormalizationLayer)
+    rnn_layers = (recmod.LSTMLayer, recmod.GRULayer, recmod.SimpleRnnLayer,
+                  recmod.BidirectionalLayer, recmod.LastTimeStepLayer,
+                  recmod.MaskZeroLayer, recmod.TimeDistributedLayer,
+                  attmod.SelfAttentionLayer, attmod.TransformerEncoderLayer,
+                  RnnOutputLayer, convmod.Subsampling1DLayer, convmod.Convolution1DLayer)
+
+    if prev.kind == "cnn_flat" and isinstance(layer, cnn_layers):
+        h, w, c = prev.shape
+        return ReshapeToCnnPreProcessor(h, w, c)
+    if prev.kind in ("cnn", "cnn3d") and isinstance(layer, (DenseLayer, OutputLayer)) \
+            and not isinstance(layer, RnnOutputLayer):
+        return FlattenPreProcessor()
+    if prev.kind == "cnn" and isinstance(layer, rnn_layers) and not isinstance(
+            layer, (convmod.Subsampling1DLayer, convmod.Convolution1DLayer)):
+        return CnnToRnnPreProcessor()
+    if prev.kind == "ff" and isinstance(layer, cnn_layers):
+        raise ValueError(
+            "feed-forward -> CNN needs an explicit ReshapeToCnnPreProcessor(h, w, c)"
+        )
+    return None

@@ -1,0 +1,262 @@
+"""ComputationGraph — the DAG model class.
+
+Reference analog: org.deeplearning4j.nn.graph.ComputationGraph — topological
+forward/backward over GraphVertex[], multiple inputs/outputs, MergeVertex /
+ElementWiseVertex residual topologies (the ResNet-50 shape).
+
+TPU-first: topological order is computed once at config-resolve; the whole
+DAG traces into a single jitted XLA program per step, multi-output losses
+summed. Params/state/opt-state are name-keyed dicts over vertices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.graph import LayerVertex
+from deeplearning4j_tpu.nn.multilayer import _tree_cast, _unpack, global_norm_clip
+from deeplearning4j_tpu.optimize.updaters import NoOp, get_updater
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        if not conf.topological_order:
+            conf.resolve()
+        self.conf = conf
+        self.params: dict = {}
+        self.state: dict = {}
+        self.opt_state: dict = {}
+        self.step_count = 0
+        self.epoch_count = 0
+        self.score_value = float("nan")
+        self.listeners: list = []
+        self._policy = BF16 if conf.dtype in ("bf16", "bfloat16") else FLOAT32
+        self._rng_key = jax.random.key(conf.seed)
+        self._jit_cache: dict = {}
+        self._updaters = {}
+        for name, v in conf.vertices.items():
+            if isinstance(v, LayerVertex):
+                l = v.layer
+                self._updaters[name] = (get_updater(l.updater) if l.updater is not None
+                                        else (NoOp() if not l.trainable else conf.updater))
+            else:
+                self._updaters[name] = conf.updater
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        seed = self.conf.seed if seed is None else seed
+        key = jax.random.key(seed)
+        self._rng_key = jax.random.fold_in(key, 0xD14)
+        self.params, self.state = {}, {}
+        for i, name in enumerate(self.conf.topological_order):
+            v = self.conf.vertices[name]
+            in_types = self._vertex_input_types(name)
+            p, s = v.init(jax.random.fold_in(key, i), in_types)
+            if p:
+                self.params[name] = p
+            if s:
+                self.state[name] = s
+        self.opt_state = {n: self._updaters[n].init_state(p) for n, p in self.params.items()}
+        return self
+
+    def _vertex_input_types(self, name):
+        types = self.conf.vertex_output_types
+        ins = []
+        for dep in self.conf.vertex_inputs.get(name, []):
+            t = types[dep]
+            if name in self.conf.preprocessors:
+                t = self.conf.preprocessors[name].output_type(t)
+            ins.append(t)
+        return ins
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.params))
+
+    def _next_key(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    @property
+    def _output_vertices(self):
+        return self.conf.network_outputs
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, state, inputs: dict, train, rng, masks=None,
+                 want_preout=False):
+        """Walk topological order. Returns (dict name->activation, new_state,
+        dict of output preouts if want_preout)."""
+        acts = dict(inputs)
+        new_state = {}
+        preouts = {}
+        for i, name in enumerate(self.conf.topological_order):
+            v = self.conf.vertices[name]
+            ins = [acts[d] for d in self.conf.vertex_inputs.get(name, [])]
+            if name in self.conf.preprocessors:
+                ins = [self.conf.preprocessors[name](ins[0])]
+            k = jax.random.fold_in(rng, i) if rng is not None else None
+            p = params.get(name, {})
+            s = state.get(name, {})
+            if want_preout and name in self._output_vertices and isinstance(v, LayerVertex) \
+                    and hasattr(v.layer, "preout"):
+                preouts[name] = v.layer.preout(p, ins[0])
+                acts[name] = preouts[name]
+                if s:
+                    new_state[name] = s
+                continue
+            out, s2 = v.apply(p, s, ins, train=train, rng=k, masks=masks)
+            acts[name] = out
+            if s2:
+                new_state[name] = s2
+        return acts, new_state, preouts
+
+    def _as_input_dict(self, xs):
+        names = self.conf.network_inputs
+        if isinstance(xs, dict):
+            return {k: jnp.asarray(v) for k, v in xs.items()}
+        if not isinstance(xs, (list, tuple)):
+            xs = [xs]
+        return {n: jnp.asarray(x) for n, x in zip(names, xs)}
+
+    # ---------------------------------------------------------------- output
+    def output(self, *xs):
+        inputs = self._as_input_dict(xs[0] if len(xs) == 1 else list(xs))
+        fn = self._jit_cache.get("output")
+        if fn is None:
+            @jax.jit
+            def fn(params, state, inputs):
+                cp = _tree_cast(params, self._policy.compute_dtype)
+                acts, _, _ = self._forward(cp, state, inputs, False, None)
+                outs = [acts[n].astype(self._policy.output_dtype)
+                        for n in self.conf.network_outputs]
+                return outs
+
+            self._jit_cache["output"] = fn
+        outs = fn(self.params, self.state, inputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------------- fit
+    def _loss(self, params, state, inputs, labels: dict, rng, masks):
+        acts, new_state, preouts = self._forward(params, state, inputs, True, rng,
+                                                 masks=masks, want_preout=True)
+        loss = 0.0
+        for name in self.conf.network_outputs:
+            v = self.conf.vertices[name]
+            if name in preouts and hasattr(v.layer, "score_from_preout"):
+                per = v.layer.score_from_preout(labels[name], preouts[name], None)
+                loss = loss + per.mean()
+            else:
+                d = acts[name] - labels[name]
+                loss = loss + (d * d).mean()
+        for name, v in self.conf.vertices.items():
+            if isinstance(v, LayerVertex) and name in params:
+                loss = loss + v.layer.regularization(params[name])
+        return loss, new_state
+
+    def _make_train_step(self):
+        updaters = self._updaters
+        max_norm = self.conf.max_grad_norm
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, state, opt_state, step, inputs, labels, key, masks):
+            def loss_fn(p):
+                cp = _tree_cast(p, self._policy.compute_dtype)
+                ci = {k: (v.astype(self._policy.compute_dtype)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in inputs.items()}
+                loss, new_state = self._loss(cp, state, ci, labels, key, masks)
+                return loss.astype(jnp.float32), new_state
+
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if max_norm > 0:
+                grads = global_norm_clip(grads, max_norm)
+            new_params, new_opt = {}, {}
+            for name, p in params.items():
+                upd, ost = updaters[name].update(grads[name], opt_state[name], p, step)
+                new_params[name] = jax.tree_util.tree_map(lambda a, d: a - d, p, upd)
+                new_opt[name] = ost
+            # carry forward unchanged state entries
+            for k, v in state.items():
+                new_state.setdefault(k, v)
+            return new_params, new_state, new_opt, loss
+
+        return train_step
+
+    def fit_batch(self, ds) -> float:
+        x, y, mask = _unpack(ds)
+        inputs = self._as_input_dict(x)
+        if isinstance(y, dict):
+            labels = {k: jnp.asarray(v) for k, v in y.items()}
+        else:
+            ys = y if isinstance(y, (list, tuple)) else [y]
+            labels = {n: jnp.asarray(v) for n, v in zip(self.conf.network_outputs, ys)}
+        fn = self._jit_cache.get("train")
+        if fn is None:
+            fn = self._make_train_step()
+            self._jit_cache["train"] = fn
+        self.params, self.state, self.opt_state, loss = fn(
+            self.params, self.state, self.opt_state,
+            jnp.asarray(self.step_count, jnp.int32), inputs, labels, self._next_key(),
+            None if mask is None else jnp.asarray(mask))
+        self.score_value = float(loss)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.step_count, self.epoch_count, self.score_value)
+        self.step_count += 1
+        return self.score_value
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        if labels is not None:
+            for _ in range(epochs):
+                self.fit_batch((data, labels))
+            return self
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            for ds in data:
+                self.fit_batch(ds)
+            if hasattr(data, "reset"):
+                data.reset()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+        return self
+
+    # ------------------------------------------------------------------ eval
+    def evaluate(self, iterator, evaluation=None) -> Evaluation:
+        ev = evaluation or Evaluation()
+        for ds in iterator:
+            x, y, mask = _unpack(ds)
+            out = self.output(x)
+            if isinstance(out, list):
+                out = out[0]
+                y = y[0] if isinstance(y, (list, tuple)) else y
+            ev.eval(np.asarray(y), np.asarray(out), mask=mask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    def score(self, ds=None) -> float:
+        return self.score_value
+
+    # ----------------------------------------------------------------- serde
+    def save(self, path: str, save_updater: bool = True):
+        from deeplearning4j_tpu.util.serialization import write_model
+
+        write_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "ComputationGraph":
+        from deeplearning4j_tpu.util.serialization import restore_computation_graph
+
+        return restore_computation_graph(path, load_updater=load_updater)
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self

@@ -1,0 +1,161 @@
+"""Attention layers.
+
+Reference analog: org.deeplearning4j.nn.conf.layers.{SelfAttentionLayer,
+LearnedSelfAttentionLayer, RecurrentAttentionLayer} [UNVERIFIED in snapshot]
+built on libnd4j's multi_head_dot_product_attention. Extended net-new with a
+full pre-norm TransformerEncoderLayer (the BERT building block the reference
+reaches only via TF-import).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, resolve_activation
+from deeplearning4j_tpu.ops.registry import op
+import deeplearning4j_tpu.ops.attention  # noqa: F401
+
+
+def _attn_mask(mask, Tq, Tk):
+    if mask is None:
+        return None
+    return mask[:, None, None, :].astype(bool)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over [B,T,F] (org...SelfAttentionLayer)."""
+
+    n_out: int
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    n_in: Optional[int] = None
+    project_input: bool = True
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.shape[1]
+        hs = self.head_size or self.n_out // self.n_heads
+        D = hs * self.n_heads
+        ks = jax.random.split(key, 4)
+        return {
+            "Wq": self._w(ks[0], (nin, D)),
+            "Wk": self._w(ks[1], (nin, D)),
+            "Wv": self._w(ks[2], (nin, D)),
+            "Wo": self._w(ks[3], (D, self.n_out)),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = op("multi_head_attention")(
+            x, x, params["Wq"], params["Wk"], params["Wv"], params["Wo"],
+            n_heads=self.n_heads, mask=_attn_mask(mask, x.shape[1], x.shape[1]),
+        )
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """Attention with n_queries learned query vectors (org...LearnedSelfAttentionLayer).
+
+    Output is [B, n_queries, n_out] — fixed-size summary of a variable sequence.
+    """
+
+    n_queries: int = 1
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def init(self, key, itype):
+        p, s = super().init(key, itype)
+        nin = self.n_in or itype.shape[1]
+        kq = jax.random.fold_in(key, 7)
+        p["Q"] = self._w(kq, (self.n_queries, nin))
+        return p, s
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        q = jnp.broadcast_to(params["Q"], (x.shape[0],) + params["Q"].shape)
+        y = op("multi_head_attention")(
+            q, x, params["Wq"], params["Wk"], params["Wv"], params["Wo"],
+            n_heads=self.n_heads, mask=_attn_mask(mask, self.n_queries, x.shape[1]),
+        )
+        return y, state
+
+    def feed_forward_mask(self, mask, itype):
+        return None
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class TransformerEncoderLayer(Layer):
+    """Pre-norm transformer encoder block — net-new (BERT/GPT building block).
+
+    MHA + residual + LN, then MLP(gelu) + residual + LN.
+    """
+
+    d_model: int
+    n_heads: int = 8
+    d_ff: Optional[int] = None
+    activation: str = "gelu"
+    dropout_rate: float = 0.0
+    causal: bool = False
+    pre_norm: bool = True
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.d_model, itype.shape[0])
+
+    def init(self, key, itype):
+        D = self.d_model
+        dff = self.d_ff or 4 * D
+        ks = jax.random.split(key, 6)
+        return {
+            "Wq": self._w(ks[0], (D, D)), "Wk": self._w(ks[1], (D, D)),
+            "Wv": self._w(ks[2], (D, D)), "Wo": self._w(ks[3], (D, D)),
+            "bq": jnp.zeros((D,)), "bk": jnp.zeros((D,)),
+            "bv": jnp.zeros((D,)), "bo": jnp.zeros((D,)),
+            "W1": self._w(ks[4], (D, dff)), "b1": jnp.zeros((dff,)),
+            "W2": self._w(ks[5], (dff, D)), "b2": jnp.zeros((D,)),
+            "ln1_g": jnp.ones((D,)), "ln1_b": jnp.zeros((D,)),
+            "ln2_g": jnp.ones((D,)), "ln2_b": jnp.zeros((D,)),
+        }, {}
+
+    def _ln(self, x, g, b):
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+    def _drop(self, x, train, rng):
+        if not train or self.dropout_rate <= 0 or rng is None:
+            return x
+        keep = 1.0 - self.dropout_rate
+        return jnp.where(jax.random.bernoulli(rng, keep, x.shape), x / keep, 0.0).astype(x.dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        r1, r2 = jax.random.split(rng) if rng is not None else (None, None)
+        am = _attn_mask(mask, x.shape[1], x.shape[1])
+
+        h = self._ln(x, params["ln1_g"], params["ln1_b"]) if self.pre_norm else x
+        a = op("multi_head_attention")(
+            h, h, params["Wq"], params["Wk"], params["Wv"], params["Wo"],
+            n_heads=self.n_heads, mask=am, causal=self.causal,
+            bq=params["bq"], bk=params["bk"], bv=params["bv"], bo=params["bo"],
+        )
+        x = x + self._drop(a, train, r1)
+        if not self.pre_norm:
+            x = self._ln(x, params["ln1_g"], params["ln1_b"])
+
+        h = self._ln(x, params["ln2_g"], params["ln2_b"]) if self.pre_norm else x
+        m = resolve_activation(self.activation)(h @ params["W1"] + params["b1"])
+        m = m @ params["W2"] + params["b2"]
+        x = x + self._drop(m, train, r2)
+        if not self.pre_norm:
+            x = self._ln(x, params["ln2_g"], params["ln2_b"])
+        return x, state

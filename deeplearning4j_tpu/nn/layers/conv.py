@@ -1,0 +1,488 @@
+"""Convolutional / pooling / spatial layers.
+
+Reference analog: org.deeplearning4j.nn.conf.layers.{ConvolutionLayer,
+Convolution1DLayer, Convolution3D, Deconvolution2D, SeparableConvolution2D,
+DepthwiseConvolution2D, SubsamplingLayer, Subsampling1DLayer, Upsampling1D/2D/3D,
+Cropping2D, ZeroPaddingLayer, SpaceToDepthLayer, GlobalPoolingLayer,
+LocalResponseNormalization} and impls in org.deeplearning4j.nn.layers.convolution.**.
+
+TPU-first: all spatial layers are NHWC (DL4J is NCHW; the model boundary
+transposes once if the user feeds NCHW). Weights are HWIO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, resolve_activation
+from deeplearning4j_tpu.ops.registry import op
+from deeplearning4j_tpu.ops.convolution import conv_out_len
+import deeplearning4j_tpu.ops.convolution  # noqa: F401  (register conv ops)
+
+
+def _t2(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _t3(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ConvolutionLayer(Layer):
+    """2D convolution (org.deeplearning4j.nn.conf.layers.ConvolutionLayer)."""
+
+    n_out: int
+    kernel: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    padding: object = "same"  # "same" | "truncate" | (ph, pw) explicit
+    dilation: tuple = (1, 1)
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = True
+    groups: int = 1
+    weight_init: str = "relu"
+
+    def output_type(self, itype):
+        h, w, _ = itype.shape
+        kh, kw = _t2(self.kernel)
+        sh, sw = _t2(self.strides)
+        dh, dw = _t2(self.dilation)
+        ph = self.padding if isinstance(self.padding, str) else _t2(self.padding)[0]
+        pw = self.padding if isinstance(self.padding, str) else _t2(self.padding)[1]
+        return InputType.convolutional(
+            conv_out_len(h, kh, sh, ph, dh), conv_out_len(w, kw, sw, pw, dw), self.n_out
+        )
+
+    def init(self, key, itype):
+        cin = self.n_in or itype.channels
+        kh, kw = _t2(self.kernel)
+        p = {"W": self._w(key, (kh, kw, cin // self.groups, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        y = op("conv2d")(
+            x, params["W"], strides=_t2(self.strides), padding=self.padding,
+            dilation=_t2(self.dilation), groups=self.groups,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Convolution1DLayer(Layer):
+    """1D conv over [batch, time, features] (org.deeplearning4j...Convolution1DLayer)."""
+
+    n_out: int
+    kernel: int = 3
+    strides: int = 1
+    padding: object = "same"
+    dilation: int = 1
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = True
+    weight_init: str = "relu"
+
+    def output_type(self, itype):
+        t = itype.shape[0]
+        pad = self.padding if isinstance(self.padding, str) else int(self.padding)
+        return InputType.recurrent(
+            self.n_out, conv_out_len(t, self.kernel, self.strides, pad, self.dilation)
+        )
+
+    def init(self, key, itype):
+        cin = self.n_in or itype.shape[1]
+        p = {"W": self._w(key, (self.kernel, cin, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        pad = self.padding if isinstance(self.padding, str) else (self.padding,)
+        y = op("conv1d")(x, params["W"], strides=self.strides, padding=pad,
+                         dilation=self.dilation)
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Convolution3DLayer(Layer):
+    """3D conv over NDHWC (org.deeplearning4j.nn.conf.layers.Convolution3D)."""
+
+    n_out: int
+    kernel: tuple = (3, 3, 3)
+    strides: tuple = (1, 1, 1)
+    padding: object = "same"
+    dilation: tuple = (1, 1, 1)
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = True
+    weight_init: str = "relu"
+
+    def output_type(self, itype):
+        d, h, w, _ = itype.shape
+        kd, kh, kw = _t3(self.kernel)
+        sd, sh, sw = _t3(self.strides)
+        dd, dh, dw = _t3(self.dilation)
+        if isinstance(self.padding, str):
+            pd = ph = pw = self.padding
+        else:
+            pd, ph, pw = _t3(self.padding)
+        return InputType.convolutional3d(
+            conv_out_len(d, kd, sd, pd, dd), conv_out_len(h, kh, sh, ph, dh),
+            conv_out_len(w, kw, sw, pw, dw), self.n_out,
+        )
+
+    def init(self, key, itype):
+        cin = self.n_in or itype.channels
+        kd, kh, kw = _t3(self.kernel)
+        p = {"W": self._w(key, (kd, kh, kw, cin, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = op("conv3d")(x, params["W"], strides=_t3(self.strides), padding=self.padding,
+                         dilation=_t3(self.dilation))
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Deconvolution2DLayer(Layer):
+    """Transposed conv (org.deeplearning4j.nn.conf.layers.Deconvolution2D)."""
+
+    n_out: int
+    kernel: tuple = (2, 2)
+    strides: tuple = (2, 2)
+    padding: object = "same"
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = True
+    weight_init: str = "relu"
+
+    def output_type(self, itype):
+        h, w, _ = itype.shape
+        kh, kw = _t2(self.kernel)
+        sh, sw = _t2(self.strides)
+        if isinstance(self.padding, str) and self.padding.lower() == "same":
+            oh, ow = (None if h is None else h * sh), (None if w is None else w * sw)
+        else:
+            p = (0, 0) if isinstance(self.padding, str) else _t2(self.padding)
+            oh = None if h is None else sh * (h - 1) + kh - 2 * p[0]
+            ow = None if w is None else sw * (w - 1) + kw - 2 * p[1]
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, itype):
+        cin = self.n_in or itype.channels
+        kh, kw = _t2(self.kernel)
+        p = {"W": self._w(key, (kh, kw, cin, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = op("deconv2d")(x, params["W"], strides=_t2(self.strides), padding=self.padding)
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SeparableConvolution2DLayer(Layer):
+    """Depthwise + pointwise conv (org.deeplearning4j...SeparableConvolution2D)."""
+
+    n_out: int
+    kernel: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    padding: object = "same"
+    depth_multiplier: int = 1
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = True
+    weight_init: str = "relu"
+
+    def output_type(self, itype):
+        h, w, _ = itype.shape
+        kh, kw = _t2(self.kernel)
+        sh, sw = _t2(self.strides)
+        ph = self.padding if isinstance(self.padding, str) else _t2(self.padding)[0]
+        pw = self.padding if isinstance(self.padding, str) else _t2(self.padding)[1]
+        return InputType.convolutional(
+            conv_out_len(h, kh, sh, ph), conv_out_len(w, kw, sw, pw), self.n_out
+        )
+
+    def init(self, key, itype):
+        import jax
+
+        cin = self.n_in or itype.channels
+        kh, kw = _t2(self.kernel)
+        k1, k2 = jax.random.split(key)
+        p = {
+            "dW": self._w(k1, (kh, kw, cin, self.depth_multiplier),
+                          fan_in=kh * kw * cin, fan_out=kh * kw * self.depth_multiplier),
+            "pW": self._w(k2, (1, 1, cin * self.depth_multiplier, self.n_out)),
+        }
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = op("depthwise_conv2d")(x, params["dW"], strides=_t2(self.strides),
+                                   padding=self.padding)
+        y = op("conv2d")(y, params["pW"], strides=(1, 1), padding="same")
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class DepthwiseConvolution2DLayer(Layer):
+    """Depthwise conv only (org.deeplearning4j...DepthwiseConvolution2D)."""
+
+    kernel: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    padding: object = "same"
+    depth_multiplier: int = 1
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = True
+    weight_init: str = "relu"
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        kh, kw = _t2(self.kernel)
+        sh, sw = _t2(self.strides)
+        ph = self.padding if isinstance(self.padding, str) else _t2(self.padding)[0]
+        pw = self.padding if isinstance(self.padding, str) else _t2(self.padding)[1]
+        return InputType.convolutional(
+            conv_out_len(h, kh, sh, ph), conv_out_len(w, kw, sw, pw),
+            c * self.depth_multiplier,
+        )
+
+    def init(self, key, itype):
+        cin = self.n_in or itype.channels
+        kh, kw = _t2(self.kernel)
+        p = {"W": self._w(key, (kh, kw, cin, self.depth_multiplier),
+                          fan_in=kh * kw, fan_out=kh * kw * self.depth_multiplier)}
+        if self.has_bias:
+            p["b"] = self._b((cin * self.depth_multiplier,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = op("depthwise_conv2d")(x, params["W"], strides=_t2(self.strides),
+                                   padding=self.padding)
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SubsamplingLayer(Layer):
+    """2D pooling (org.deeplearning4j.nn.conf.layers.SubsamplingLayer).
+
+    pooling_type: "max" | "avg" | "pnorm".
+    """
+
+    kernel: tuple = (2, 2)
+    strides: Optional[tuple] = None
+    padding: object = "valid"
+    pooling_type: str = "max"
+    pnorm: int = 2
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        kh, kw = _t2(self.kernel)
+        sh, sw = _t2(self.strides or self.kernel)
+        ph = self.padding if isinstance(self.padding, str) else _t2(self.padding)[0]
+        pw = self.padding if isinstance(self.padding, str) else _t2(self.padding)[1]
+        return InputType.convolutional(conv_out_len(h, kh, sh, ph), conv_out_len(w, kw, sw, pw), c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        k = _t2(self.kernel)
+        s = _t2(self.strides or self.kernel)
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            return op("maxpool2d")(x, kernel=k, strides=s, padding=self.padding), state
+        if pt in ("avg", "average"):
+            return op("avgpool2d")(x, kernel=k, strides=s, padding=self.padding), state
+        if pt == "pnorm":
+            return op("pnormpool2d")(x, kernel=k, strides=s, padding=self.padding,
+                                     pnorm=self.pnorm), state
+        raise ValueError(f"unknown pooling type {self.pooling_type}")
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Subsampling1DLayer(Layer):
+    """1D pooling over [batch, time, features]."""
+
+    kernel: int = 2
+    strides: Optional[int] = None
+    padding: object = "valid"
+    pooling_type: str = "max"
+
+    def output_type(self, itype):
+        t, f = itype.shape
+        s = self.strides or self.kernel
+        pad = self.padding if isinstance(self.padding, str) else int(self.padding)
+        return InputType.recurrent(f, conv_out_len(t, self.kernel, s, pad))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x4 = x[:, :, None, :]  # [B, T, 1, F]
+        k = (self.kernel, 1)
+        s = (self.strides or self.kernel, 1)
+        name = "maxpool2d" if self.pooling_type.lower() == "max" else "avgpool2d"
+        y = op(name)(x4, kernel=k, strides=s, padding=self.padding)
+        return y[:, :, 0, :], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Upsampling2DLayer(Layer):
+    size: tuple = (2, 2)
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        sh, sw = _t2(self.size)
+        return InputType.convolutional(None if h is None else h * sh,
+                                       None if w is None else w * sw, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return op("upsampling2d")(x, size=_t2(self.size)), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Cropping2DLayer(Layer):
+    """Crop [(top,bottom),(left,right)] (org.deeplearning4j...convolutional.Cropping2D)."""
+
+    crop: tuple = ((0, 0), (0, 0))
+
+    def _norm(self):
+        c = self.crop
+        if isinstance(c[0], int):
+            c = ((c[0], c[0]), (c[1], c[1])) if len(c) == 2 else ((c[0], c[1]), (c[2], c[3]))
+        return c
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        (t, b), (l, r) = self._norm()
+        return InputType.convolutional(None if h is None else h - t - b,
+                                       None if w is None else w - l - r, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        (t, b), (l, r) = self._norm()
+        return x[:, t : x.shape[1] - b, l : x.shape[2] - r, :], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ZeroPadding2DLayer(Layer):
+    pad: tuple = ((1, 1), (1, 1))
+
+    def _norm(self):
+        p = self.pad
+        if isinstance(p[0], int):
+            p = ((p[0], p[0]), (p[1], p[1])) if len(p) == 2 else ((p[0], p[1]), (p[2], p[3]))
+        return p
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        (t, b), (l, r) = self._norm()
+        return InputType.convolutional(None if h is None else h + t + b,
+                                       None if w is None else w + l + r, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        (t, b), (l, r) = self._norm()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SpaceToDepthLayer(Layer):
+    block: int = 2
+
+    def output_type(self, itype):
+        h, w, c = itype.shape
+        return InputType.convolutional(h // self.block, w // self.block,
+                                       c * self.block * self.block)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return op("space_to_depth")(x, block=self.block), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial/time dims (org.deeplearning4j...GlobalPoolingLayer).
+
+    Works on CNN [B,H,W,C] -> [B,C] and RNN [B,T,F] -> [B,F]; honours the
+    time mask for RNN input (masked mean/max — DL4J's masked pooling).
+    """
+
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def output_type(self, itype):
+        if itype.kind == "rnn":
+            return InputType.feed_forward(itype.shape[1])
+        return InputType.feed_forward(itype.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:  # RNN masked pooling
+            m = mask[..., None].astype(x.dtype)
+            if pt in ("avg", "average"):
+                return (x * m).sum(axes) / jnp.maximum(m.sum(axes), 1.0), state
+            if pt == "sum":
+                return (x * m).sum(axes), state
+            if pt == "max":
+                neg = jnp.finfo(x.dtype).min
+                return jnp.where(m > 0, x, neg).max(axes), state
+        if pt == "max":
+            return x.max(axes), state
+        if pt in ("avg", "average"):
+            return x.mean(axes), state
+        if pt == "sum":
+            return x.sum(axes), state
+        if pt == "pnorm":
+            return (jnp.abs(x) ** self.pnorm).sum(axes) ** (1.0 / self.pnorm), state
+        raise ValueError(f"unknown pooling type {self.pooling_type}")
+
+    def feed_forward_mask(self, mask, itype):
+        return None
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LocalResponseNormalizationLayer(Layer):
+    """LRN (org.deeplearning4j.nn.conf.layers.LocalResponseNormalization)."""
+
+    depth: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+    k: float = 2.0
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return op("lrn")(x, depth=self.depth, alpha=self.alpha, beta=self.beta, k=self.k), state

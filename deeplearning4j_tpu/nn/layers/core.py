@@ -1,0 +1,175 @@
+"""Core feed-forward layers.
+
+Reference analog: org.deeplearning4j.nn.conf.layers.{DenseLayer, ActivationLayer,
+DropoutLayer, EmbeddingLayer, EmbeddingSequenceLayer, ElementWiseMultiplicationLayer}
+and their impls in org.deeplearning4j.nn.layers.feedforward.**.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import jax.random
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, resolve_activation
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class DenseLayer(Layer):
+    """Fully connected layer: act(x @ W + b).
+
+    Reference: org.deeplearning4j.nn.conf.layers.DenseLayer /
+    org.deeplearning4j.nn.layers.feedforward.dense.DenseLayer.
+    """
+
+    n_out: int
+    n_in: Optional[int] = None
+    activation: str = "sigmoid"  # DL4J historical default
+    has_bias: bool = True
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.size
+        p = {"W": self._w(key, (nin, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ActivationLayer(Layer):
+    """Applies an activation only (org.deeplearning4j.nn.conf.layers.ActivationLayer)."""
+
+    activation: str = "relu"
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return resolve_activation(self.activation)(x), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class DropoutLayer(Layer):
+    """Standalone inverted dropout (org.deeplearning4j.nn.conf.layers.DropoutLayer).
+
+    ``rate`` is the DROP probability (DL4J's dropOut field is the *keep*
+    probability — we use drop probability, the modern convention; serialization
+    notes the field name difference).
+    """
+
+    rate: float = 0.5
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if not train or self.rate <= 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("DropoutLayer needs rng during training")
+        keep = 1.0 - self.rate
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(m, x / keep, 0.0).astype(x.dtype), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class EmbeddingLayer(Layer):
+    """Index -> vector lookup, one index per example.
+
+    Reference: org.deeplearning4j.nn.conf.layers.EmbeddingLayer (input: [batch, 1]
+    integer indices; equivalent to a Dense layer with one-hot input but O(1)).
+    """
+
+    n_out: int
+    n_in: Optional[int] = None  # vocab size
+    activation: str = "identity"
+    has_bias: bool = False
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        vocab = self.n_in or itype.size
+        p = {"W": self._w(key, (vocab, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = params["W"][idx]
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class EmbeddingSequenceLayer(Layer):
+    """Sequence of indices -> sequence of vectors.
+
+    Reference: org.deeplearning4j.nn.conf.layers.EmbeddingSequenceLayer.
+    Output layout is time-major-free [batch, time, features] (TPU/NTF; DL4J
+    uses NCW [batch, features, time] — converted at the model boundary).
+    """
+
+    n_out: int
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    has_bias: bool = False
+    inference_max_len: Optional[int] = None
+
+    def output_type(self, itype):
+        t = itype.shape[0] if itype.kind == "rnn" else None
+        return InputType.recurrent(self.n_out, t)
+
+    def init(self, key, itype):
+        vocab = self.n_in or (itype.size if itype.kind != "rnn" else itype.shape[1])
+        p = {"W": self._w(key, (vocab, self.n_out))}
+        if self.has_bias:
+            p["b"] = self._b((self.n_out,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = params["W"][idx]  # [B, T, n_out]
+        if self.has_bias:
+            y = y + params["b"]
+        return resolve_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class ElementWiseMultiplicationLayer(Layer):
+    """out = act(x * w + b), learned per-feature scale.
+
+    Reference: org.deeplearning4j.nn.conf.layers.misc.ElementWiseMultiplicationLayer.
+    """
+
+    n_out: Optional[int] = None
+    activation: str = "identity"
+
+    def init(self, key, itype):
+        n = self.n_out or itype.size
+        return {"W": jnp.ones((n,)), "b": self._b((n,))}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y = x * params["W"] + params["b"]
+        return resolve_activation(self.activation)(y), state

@@ -1,0 +1,102 @@
+"""Normalization layers.
+
+Reference analog: org.deeplearning4j.nn.conf.layers.BatchNormalization (+ the
+CudnnBatchNormalizationHelper it swaps in on GPU) and LayerNormalization
+[UNVERIFIED in snapshot]. On TPU, batch-norm is pure XLA — the fused
+mean/var/scale lowering is what cuDNN provided; running stats live in the
+model's mutable ``state`` pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class BatchNormalizationLayer(Layer):
+    """Batch norm over the channel/feature (last) axis.
+
+    DL4J semantics kept: ``decay`` is the running-average retention factor
+    (global_mean = decay * global_mean + (1-decay) * batch_mean), eps default
+    1e-5, optional lock of gamma/beta.
+    """
+
+    n_out: Optional[int] = None  # inferred
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    use_mean_var_from_state: bool = False  # inference-style forward even in train
+
+    def _n(self, itype):
+        return self.n_out or (itype.channels if itype.kind in ("cnn", "cnn3d") else itype.size
+                              if itype.kind != "rnn" else itype.shape[1])
+
+    def init(self, key, itype):
+        n = self._n(itype)
+        p = {} if self.lock_gamma_beta else {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+        s = {"mean": jnp.zeros((n,)), "var": jnp.ones((n,))}
+        return p, s
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))
+        if train and not self.use_mean_var_from_state:
+            mean = x.mean(axes)
+            var = x.var(axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"] + params["beta"]
+        return xhat.astype(x.dtype), new_state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LayerNormalizationLayer(Layer):
+    """Layer norm over the feature (last) axis — the transformer workhorse."""
+
+    n_out: Optional[int] = None
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+
+    def init(self, key, itype):
+        n = self.n_out or (itype.shape[-1] if itype.kind != "ff" else itype.size)
+        if not self.elementwise_affine:
+            return {}, {}
+        return {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        xhat = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        if self.elementwise_affine:
+            xhat = xhat * params["gamma"] + params["beta"]
+        return xhat.astype(x.dtype), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RMSNormLayer(Layer):
+    """RMSNorm — net-new (modern LLM blocks); no DL4J analog."""
+
+    n_out: Optional[int] = None
+    eps: float = 1e-6
+
+    def init(self, key, itype):
+        n = self.n_out or itype.shape[-1]
+        return {"gamma": jnp.ones((n,))}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        ms = (x * x).mean(-1, keepdims=True)
+        return (x * jnp.reciprocal(jnp.sqrt(ms + self.eps)) * params["gamma"]).astype(x.dtype), state

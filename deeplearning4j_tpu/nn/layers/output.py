@@ -1,0 +1,145 @@
+"""Output / loss layers.
+
+Reference analog: org.deeplearning4j.nn.conf.layers.{OutputLayer, RnnOutputLayer,
+LossLayer, CenterLossOutputLayer} + org.deeplearning4j.nn.layers.BaseOutputLayer.
+An output layer = (optional dense transform) + activation + loss; ``score``
+returns per-example loss values so masking/weighting compose upstream, exactly
+like ILossFunction.computeScoreArray.
+
+Fused numerics: when activation is softmax and loss is MCXENT (or sigmoid+XENT),
+``score_from_preout`` uses the logits path (log_softmax / logaddexp) — the
+numerically-stable fusion cuDNN/DL4J special-cased, done here in plain XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, resolve_activation
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.ops.losses import get_loss
+
+
+def _fused(activation: str, loss: str) -> bool:
+    a = activation.lower().replace("_", "")
+    l = loss.lower().replace("_", "")
+    return (a == "softmax" and l in ("mcxent", "negativeloglikelihood")) or (
+        a == "sigmoid" and l == "xent"
+    )
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class OutputLayer(DenseLayer):
+    """Dense + activation + loss (org.deeplearning4j.nn.conf.layers.OutputLayer)."""
+
+    loss: str = "mcxent"
+    activation: str = "softmax"
+
+    def preout(self, params, x):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        return resolve_activation(self.activation)(self.preout(params, x)), state
+
+    def score_from_preout(self, labels, preout, mask=None):
+        """Per-example loss given pre-activation output (stable fused path)."""
+        fn = get_loss(self.loss)
+        if _fused(self.activation, self.loss):
+            return fn(labels, preout, mask, from_logits=True)
+        return fn(labels, resolve_activation(self.activation)(preout), mask)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output layer for sequences.
+
+    Reference: org.deeplearning4j.nn.conf.layers.RnnOutputLayer. Input/output
+    [batch, time, features]; loss computed per timestep then masked + summed.
+    """
+
+    def output_type(self, itype):
+        t = itype.shape[0] if itype.kind == "rnn" else None
+        return InputType.recurrent(self.n_out, t)
+
+    def preout(self, params, x):
+        y = x @ params["W"]  # [B, T, nout]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def score_from_preout(self, labels, preout, mask=None):
+        fn = get_loss(self.loss)
+        b, t = preout.shape[0], preout.shape[1]
+        p2 = preout.reshape(b * t, -1)
+        l2 = labels.reshape(b * t, -1)
+        m2 = mask.reshape(b * t) if mask is not None else None
+        if _fused(self.activation, self.loss):
+            per = fn(l2, p2, m2, from_logits=True)
+        else:
+            per = fn(l2, resolve_activation(self.activation)(p2), m2)
+        # sum over time -> per-example score (DL4J averages over *present* steps
+        # at the score level; we sum here and normalize in the model by mask sum)
+        return per.reshape(b, t).sum(axis=1)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LossLayer(Layer):
+    """Loss without parameters (org.deeplearning4j.nn.conf.layers.LossLayer)."""
+
+    loss: str = "mcxent"
+    activation: str = "identity"
+
+    def preout(self, params, x):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return resolve_activation(self.activation)(x), state
+
+    def score_from_preout(self, labels, preout, mask=None):
+        fn = get_loss(self.loss)
+        if _fused(self.activation, self.loss):
+            return fn(labels, preout, mask, from_logits=True)
+        return fn(labels, resolve_activation(self.activation)(preout), mask)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (org.deeplearning4j.nn.conf.layers.CenterLossOutputLayer).
+
+    Maintains per-class feature centers in ``state``; loss = CE + alpha/2 *
+    ||f - c_y||^2, centers updated with rate lambda toward class means.
+    """
+
+    alpha: float = 0.05
+    lambda_: float = 0.5  # DL4J 'lambda'; trailing underscore for Python keyword-safety
+    gradient_check: bool = False
+
+    def init(self, key, itype):
+        p, _ = super().init(key, itype)
+        nin = self.n_in or itype.size
+        return p, {"centers": jnp.zeros((self.n_out, nin))}
+
+    def center_score_and_state(self, params, state, features, labels):
+        centers = state["centers"]
+        cls = jnp.argmax(labels, axis=-1)
+        diff = features - centers[cls]
+        score = 0.5 * self.alpha * (diff * diff).sum(axis=-1)
+        # center update: c_j += lambda * mean_{i: y_i=j}(f_i - c_j)
+        counts = labels.sum(axis=0)[:, None] + 1.0
+        delta = (labels.T @ features - counts * centers + centers) / counts
+        new_centers = centers + self.lambda_ * delta
+        return score, {"centers": new_centers}

@@ -1,0 +1,280 @@
+"""Recurrent layers.
+
+Reference analog: org.deeplearning4j.nn.conf.layers.{LSTM, GravesLSTM,
+GravesBidirectionalLSTM, SimpleRnn} + org.deeplearning4j.nn.conf.layers.recurrent.
+{Bidirectional, LastTimeStep, SimpleRnn} and impls in
+org.deeplearning4j.nn.layers.recurrent.**.
+
+Sequence layout is [batch, time, features] (DL4J uses [batch, features, time];
+transposed once at the model boundary). Param keys mirror DL4J: "W" (input
+weights), "RW" (recurrent weights), "b"; GravesLSTM adds "pW" (peepholes).
+
+Stateful truncated-BPTT inference (rnnTimeStep) is supported via the model
+class keeping (h, c) in its state dict under the layer name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, resolve_activation
+from deeplearning4j_tpu.ops.registry import op
+import deeplearning4j_tpu.ops.recurrent  # noqa: F401  (register ops)
+
+
+def _mask_outputs(ys, mask):
+    if mask is None:
+        return ys
+    return ys * mask[..., None].astype(ys.dtype)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LSTMLayer(Layer):
+    """Standard LSTM (org.deeplearning4j.nn.conf.layers.LSTM — no peepholes)."""
+
+    n_out: int
+    n_in: Optional[int] = None
+    activation: str = "tanh"  # cell candidate activation
+    forget_gate_bias_init: float = 1.0
+    weight_init: str = "xavier"
+
+    peephole = False
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.shape[1]
+        H = self.n_out
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = {
+            "W": self._w(k1, (nin, 4 * H), fan_in=nin, fan_out=H),
+            "RW": self._w(k2, (H, 4 * H), fan_in=H, fan_out=H),
+            "b": jnp.zeros((4 * H,)).at[H : 2 * H].set(self.forget_gate_bias_init),
+        }
+        if self.peephole:
+            p["pW"] = jnp.zeros((3 * H,))
+        return p, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        B = x.shape[0]
+        h0 = jnp.zeros((B, self.n_out), x.dtype)
+        c0 = jnp.zeros((B, self.n_out), x.dtype)
+        ys, _ = op("lstm_layer")(x, h0, c0, params["W"], params["RW"], params["b"],
+                                 peephole=params.get("pW"))
+        return _mask_outputs(ys, mask), state
+
+    def step(self, params, carry, x_t):
+        """Single-timestep advance (rnnTimeStep analog). carry=(h,c), x_t [B,F]."""
+        ys, (h, c) = op("lstm_layer")(x_t[:, None, :], carry[0], carry[1],
+                                      params["W"], params["RW"], params["b"],
+                                      peephole=params.get("pW"))
+        return (h, c), ys[:, 0]
+
+    def initial_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype), jnp.zeros((batch, self.n_out), dtype))
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class GravesLSTMLayer(LSTMLayer):
+    """LSTM with peephole connections (org.deeplearning4j.nn.conf.layers.GravesLSTM,
+    per Graves 2013; cuDNN couldn't accelerate these — our scan lowering handles
+    them at no extra structural cost)."""
+
+    peephole = True
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class GRULayer(Layer):
+    """GRU (libnd4j gruCell analog)."""
+
+    n_out: int
+    n_in: Optional[int] = None
+    weight_init: str = "xavier"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.shape[1]
+        H = self.n_out
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": self._w(k1, (nin, 3 * H), fan_in=nin, fan_out=H),
+            "RW": self._w(k2, (H, 3 * H), fan_in=H, fan_out=H),
+            "b": jnp.zeros((3 * H,)),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+        ys, _ = op("gru_layer")(x, h0, params["W"], params["RW"], params["b"])
+        return _mask_outputs(ys, mask), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class SimpleRnnLayer(Layer):
+    """Elman RNN (org.deeplearning4j.nn.conf.layers.recurrent.SimpleRnn)."""
+
+    n_out: int
+    n_in: Optional[int] = None
+    activation: str = "tanh"
+    weight_init: str = "xavier"
+
+    def output_type(self, itype):
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.shape[1]
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": self._w(k1, (nin, self.n_out)),
+            "RW": self._w(k2, (self.n_out, self.n_out)),
+            "b": jnp.zeros((self.n_out,)),
+        }, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        h0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+        act = resolve_activation(self.activation)
+        ys, _ = op("simple_rnn_layer")(x, h0, params["W"], params["RW"], params["b"],
+                                       activation=act)
+        return _mask_outputs(ys, mask), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class BidirectionalLayer(Layer):
+    """Wraps any recurrent layer fwd+bwd (org.deeplearning4j...recurrent.Bidirectional).
+
+    mode: concat | add | mul | average (DL4J Bidirectional.Mode).
+    """
+
+    fwd: Layer = None
+    mode: str = "concat"
+
+    def output_type(self, itype):
+        ot = self.fwd.output_type(itype)
+        if self.mode == "concat":
+            return InputType.recurrent(ot.shape[1] * 2, ot.shape[0])
+        return ot
+
+    def init(self, key, itype):
+        k1, k2 = jax.random.split(key)
+        pf, sf = self.fwd.init(k1, itype)
+        pb, sb = self.fwd.init(k2, itype)
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        r1, r2 = jax.random.split(rng) if rng is not None else (None, None)
+        yf, _ = self.fwd.apply(params["fwd"], {}, x, train=train, rng=r1, mask=mask)
+        xr = jnp.flip(x, axis=1)
+        mr = jnp.flip(mask, axis=1) if mask is not None else None
+        yb, _ = self.fwd.apply(params["bwd"], {}, xr, train=train, rng=r2, mask=mr)
+        yb = jnp.flip(yb, axis=1)
+        m = self.mode.lower()
+        if m == "concat":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if m == "add":
+            return yf + yb, state
+        if m == "mul":
+            return yf * yb, state
+        if m in ("average", "avg"):
+            return 0.5 * (yf + yb), state
+        raise ValueError(f"unknown Bidirectional mode {self.mode}")
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class GravesBidirectionalLSTMLayer(BidirectionalLayer):
+    """org.deeplearning4j.nn.conf.layers.GravesBidirectionalLSTM == Bidirectional(GravesLSTM)."""
+
+    n_out: int = 0
+    n_in: Optional[int] = None
+    fwd: Layer = None
+
+    def __post_init__(self):
+        if self.fwd is None:
+            object.__setattr__(
+                self, "fwd", GravesLSTMLayer(n_out=self.n_out, n_in=self.n_in)
+            )
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class LastTimeStepLayer(Layer):
+    """[B,T,F] -> [B,F] taking last *unmasked* step (org...recurrent.LastTimeStep)."""
+
+    underlying: Optional[Layer] = None
+
+    def output_type(self, itype):
+        it = self.underlying.output_type(itype) if self.underlying else itype
+        return InputType.feed_forward(it.shape[1])
+
+    def init(self, key, itype):
+        if self.underlying:
+            return self.underlying.init(key, itype)
+        return {}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if self.underlying:
+            x, state = self.underlying.apply(params, state, x, train=train, rng=rng, mask=mask)
+        if mask is None:
+            return x[:, -1, :], state
+        idx = jnp.maximum(mask.sum(axis=1).astype(jnp.int32) - 1, 0)
+        return x[jnp.arange(x.shape[0]), idx], state
+
+    def feed_forward_mask(self, mask, itype):
+        return None
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class MaskZeroLayer(Layer):
+    """Sets mask where input==value (org...recurrent.MaskZeroLayer)."""
+
+    underlying: Optional[Layer] = None
+    mask_value: float = 0.0
+
+    def output_type(self, itype):
+        return self.underlying.output_type(itype) if self.underlying else itype
+
+    def init(self, key, itype):
+        return self.underlying.init(key, itype) if self.underlying else ({}, {})
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        computed = jnp.any(x != self.mask_value, axis=-1).astype(jnp.float32)
+        if self.underlying:
+            return self.underlying.apply(params, state, x, train=train, rng=rng, mask=computed)
+        return x, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class TimeDistributedLayer(Layer):
+    """Applies a FF layer to every timestep (org...recurrent.TimeDistributed)."""
+
+    underlying: Layer = None
+
+    def output_type(self, itype):
+        inner = self.underlying.output_type(InputType.feed_forward(itype.shape[1]))
+        return InputType.recurrent(inner.size, itype.shape[0])
+
+    def init(self, key, itype):
+        return self.underlying.init(key, InputType.feed_forward(itype.shape[1]))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        y, state = self.underlying.apply(params, state, x.reshape(b * t, -1),
+                                         train=train, rng=rng)
+        return y.reshape(b, t, -1), state

@@ -1,0 +1,152 @@
+"""Weight initialization schemes.
+
+Reference analog: deeplearning4j-nn :: org.deeplearning4j.nn.weights.WeightInit
+enum + WeightInitUtil (XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, RELU, RELU_UNIFORM,
+LECUN_NORMAL/UNIFORM, HE (== RELU), SIGMOID_UNIFORM, UNIFORM, NORMAL, ZERO, ONES,
+DISTRIBUTION, IDENTITY, VAR_SCALING_*). DL4J computes fan-in/fan-out from the
+weight shape the same way; we keep the same names so configs round-trip.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape, fan_in=None, fan_out=None):
+    """fan_in/fan_out for a weight shape.
+
+    Dense: (nin, nout). Conv HWIO: (kh, kw, cin, cout) ->
+    fan_in = kh*kw*cin, fan_out = kh*kw*cout (matches DL4J's
+    WeightInitUtil receptive-field convention).
+    """
+    if fan_in is not None and fan_out is not None:
+        return fan_in, fan_out
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = 1
+    for d in shape[:-2]:
+        receptive *= d
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+def init_weight(key, shape, scheme="xavier", dtype=jnp.float32, fan_in=None, fan_out=None,
+                distribution=None):
+    """Sample a weight array for the named scheme (DL4J WeightInit names)."""
+    scheme = str(scheme).lower()
+    fi, fo = _fans(shape, fan_in, fan_out)
+
+    if scheme in ("zero", "zeros"):
+        return jnp.zeros(shape, dtype)
+    if scheme in ("one", "ones"):
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-d weight")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "distribution":
+        if distribution is None:
+            raise ValueError("DISTRIBUTION init requires a distribution")
+        return distribution.sample(key, shape).astype(dtype)
+
+    normal = lambda std: std * jax.random.normal(key, shape, dtype)
+    uniform = lambda a: jax.random.uniform(key, shape, dtype, -a, a)
+
+    if scheme == "xavier":
+        return normal(math.sqrt(2.0 / (fi + fo)))
+    if scheme in ("xavier_uniform", "xavieruniform"):
+        return uniform(math.sqrt(6.0 / (fi + fo)))
+    if scheme in ("xavier_fan_in", "xavierfanin"):
+        return normal(math.sqrt(1.0 / fi))
+    if scheme in ("relu", "he", "he_normal", "henormal"):
+        return normal(math.sqrt(2.0 / fi))
+    if scheme in ("relu_uniform", "reluuniform", "he_uniform", "heuniform"):
+        return uniform(math.sqrt(6.0 / fi))
+    if scheme in ("lecun_normal", "lecunnormal"):
+        return normal(math.sqrt(1.0 / fi))
+    if scheme in ("lecun_uniform", "lecununiform"):
+        return uniform(math.sqrt(3.0 / fi))
+    if scheme in ("sigmoid_uniform", "sigmoiduniform"):
+        return uniform(4.0 * math.sqrt(6.0 / (fi + fo)))
+    if scheme == "uniform":
+        a = 1.0 / math.sqrt(fi)
+        return uniform(a)
+    if scheme == "normal":
+        return normal(1.0 / math.sqrt(fi))
+    if scheme in ("var_scaling_normal_fan_in", "varscalingnormalfanin"):
+        return normal(math.sqrt(1.0 / fi))
+    if scheme in ("var_scaling_normal_fan_out", "varscalingnormalfanout"):
+        return normal(math.sqrt(1.0 / fo))
+    if scheme in ("var_scaling_normal_fan_avg", "varscalingnormalfanavg"):
+        return normal(math.sqrt(2.0 / (fi + fo)))
+    if scheme in ("var_scaling_uniform_fan_in", "varscalinguniformfanin"):
+        return uniform(math.sqrt(3.0 / fi))
+    if scheme in ("var_scaling_uniform_fan_out", "varscalinguniformfanout"):
+        return uniform(math.sqrt(3.0 / fo))
+    if scheme in ("var_scaling_uniform_fan_avg", "varscalinguniformfanavg"):
+        return uniform(math.sqrt(6.0 / (fi + fo)))
+    raise ValueError(f"unknown weight init scheme '{scheme}'")
+
+
+class Distribution:
+    """Serializable sampling distribution (org.deeplearning4j.nn.conf.distribution)."""
+
+    def sample(self, key, shape):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dict(self.__dict__)
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        t = d.pop("@type")
+        return {c.__name__: c for c in (NormalDistribution, UniformDistribution,
+                                        TruncatedNormalDistribution, ConstantDistribution,
+                                        OrthogonalDistribution)}[t](**d)
+
+
+class NormalDistribution(Distribution):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def sample(self, key, shape):
+        return self.mean + self.std * jax.random.normal(key, shape)
+
+
+class UniformDistribution(Distribution):
+    def __init__(self, lower=-1.0, upper=1.0):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, key, shape):
+        return jax.random.uniform(key, shape, minval=self.lower, maxval=self.upper)
+
+
+class TruncatedNormalDistribution(Distribution):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def sample(self, key, shape):
+        return self.mean + self.std * jax.random.truncated_normal(key, -2.0, 2.0, shape)
+
+
+class ConstantDistribution(Distribution):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def sample(self, key, shape):
+        return jnp.full(shape, self.value)
+
+
+class OrthogonalDistribution(Distribution):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def sample(self, key, shape):
+        return self.gain * jax.nn.initializers.orthogonal()(key, shape)

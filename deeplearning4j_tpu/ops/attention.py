@@ -1,0 +1,59 @@
+"""Attention ops.
+
+Reference analog: libnd4j dot_product_attention / multi_head_dot_product_attention
+(libnd4j/include/ops/declarable/generic/nn/attention/**) used by DL4J's
+SelfAttentionLayer. TPU-first: the registry's plain lowering is a blockwise-
+friendly softmax(QK^T)V that XLA fuses well at small scale; a Pallas flash
+-attention kernel registers over it for long sequences (see
+ops/pallas/flash_attention.py), selected by predicate on seq length — the
+cuDNN-helper pattern.
+
+Layouts: q/k/v [B, N, T, Dh] (batch, heads, time, head_dim).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("dot_product_attention")
+def dot_product_attention(q, k, v, *, mask=None, scale=None, causal=False):
+    """softmax(q k^T / sqrt(d)) v.
+
+    mask: broadcastable to [B, N, Tq, Tk], 1=keep 0=drop (additive -inf applied).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    logits = jnp.einsum("bntd,bnsd->bnts", q, k) * scale
+    neg = jnp.finfo(logits.dtype).min
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(cm, logits, neg)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, neg)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnts,bnsd->bntd", w, v)
+
+
+@register_op("multi_head_attention")
+def multi_head_attention(x_q, x_kv, Wq, Wk, Wv, Wo, *, n_heads, mask=None, causal=False,
+                         bq=None, bk=None, bv=None, bo=None):
+    """Full MHA: project, attend, merge. x [B, T, F]; W* [F, D]; Wo [D, F_out]."""
+    B, Tq, _ = x_q.shape
+    Tk = x_kv.shape[1]
+    q = x_q @ Wq + (0 if bq is None else bq)
+    k = x_kv @ Wk + (0 if bk is None else bk)
+    v = x_kv @ Wv + (0 if bv is None else bv)
+    Dh = q.shape[-1] // n_heads
+
+    def split(t, T):
+        return t.reshape(B, T, n_heads, Dh).transpose(0, 2, 1, 3)
+
+    o = dot_product_attention(split(q, Tq), split(k, Tk), split(v, Tk),
+                              mask=mask, causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(B, Tq, n_heads * Dh)
+    return o @ Wo + (0 if bo is None else bo)

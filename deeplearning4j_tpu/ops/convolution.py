@@ -1,0 +1,230 @@
+"""Convolution / pooling ops (plain-XLA lowerings, registry-addressable).
+
+Reference analog: libnd4j declarable ops conv2d/conv1d/conv3d/deconv2d/
+depthwise_conv2d/maxpool2d/avgpool2d/lrn
+(libnd4j/include/ops/declarable/generic/nn/convo/**, .../pooling/**) and their
+cuDNN platform overrides (libnd4j/include/ops/declarable/platform/cudnn/).
+TPU-first: layouts are NHWC/HWIO (what Mosaic/XLA tile best on the MXU);
+XLA's conv lowering already is the "cuDNN-class" kernel on TPU, so the
+registry's plain lowering is expected to win for forward conv — Pallas
+overrides slot in per-op via register_impl where profiling says otherwise.
+
+All ops take/return channels-last arrays and are shape-polymorphic under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+def _pad2(padding, kernel, strides, dilation=(1, 1)):
+    """DL4J ConvolutionMode -> lax padding spec.
+
+    'same' -> SAME; 'truncate'/'strict'/explicit tuple -> explicit pads.
+    """
+    if isinstance(padding, str):
+        p = padding.lower()
+        if p == "same":
+            return "SAME"
+        if p in ("valid", "truncate", "strict"):
+            return "VALID"
+        raise ValueError(f"unknown padding '{padding}'")
+    return [(int(p), int(p)) for p in padding]
+
+
+@register_op("conv2d")
+def conv2d(x, w, *, strides=(1, 1), padding="same", dilation=(1, 1), groups=1):
+    """NHWC x HWIO -> NHWC convolution."""
+    return lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=tuple(strides),
+        padding=_pad2(padding, w.shape[:2], strides, dilation),
+        rhs_dilation=tuple(dilation),
+        feature_group_count=groups,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@register_op("conv1d")
+def conv1d(x, w, *, strides=1, padding="same", dilation=1):
+    """NWC x WIO -> NWC."""
+    return lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(int(strides),),
+        padding=_pad2(padding, w.shape[:1], (strides,), (dilation,)),
+        rhs_dilation=(int(dilation),),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+
+
+@register_op("conv3d")
+def conv3d(x, w, *, strides=(1, 1, 1), padding="same", dilation=(1, 1, 1)):
+    """NDHWC x DHWIO -> NDHWC."""
+    return lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=tuple(strides),
+        padding=_pad2(padding, w.shape[:3], strides, dilation),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+
+
+@register_op("deconv2d")
+def deconv2d(x, w, *, strides=(1, 1), padding="same"):
+    """Transposed conv, NHWC x HWIO(out=last) -> NHWC."""
+    return lax.conv_transpose(
+        x,
+        w.astype(x.dtype),
+        strides=tuple(strides),
+        padding="SAME" if (isinstance(padding, str) and padding.lower() == "same") else
+        ("VALID" if isinstance(padding, str) else [(int(p), int(p)) for p in padding]),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(x, w, *, strides=(1, 1), padding="same", dilation=(1, 1)):
+    """Depthwise conv: w is HWC(mult) reshaped to HWI(1*mult) with groups=C."""
+    c = x.shape[-1]
+    kh, kw, cin, mult = w.shape
+    assert cin == c, f"depthwise weight channel dim {cin} != input channels {c}"
+    w2 = w.reshape(kh, kw, 1, c * mult)
+    return lax.conv_general_dilated(
+        x,
+        w2.astype(x.dtype),
+        window_strides=tuple(strides),
+        padding=_pad2(padding, (kh, kw), strides, dilation),
+        rhs_dilation=tuple(dilation),
+        feature_group_count=c,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _pool_pad(padding, rank):
+    if isinstance(padding, str):
+        p = padding.lower()
+        return "SAME" if p == "same" else "VALID"
+    return [(0, 0)] + [(int(p), int(p)) for p in padding] + [(0, 0)]
+
+
+@register_op("maxpool2d")
+def maxpool2d(x, *, kernel=(2, 2), strides=None, padding="valid"):
+    strides = strides or kernel
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,),
+        _pool_pad(padding, 2),
+    )
+
+
+@register_op("avgpool2d")
+def avgpool2d(x, *, kernel=(2, 2), strides=None, padding="valid"):
+    strides = strides or kernel
+    dims = (1,) + tuple(kernel) + (1,)
+    strd = (1,) + tuple(strides) + (1,)
+    pad = _pool_pad(padding, 2)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strd, pad)
+    if pad == "SAME":
+        # divide by actual window size (count_include_pad=False, DL4J default)
+        ones = jnp.ones(x.shape[:1] + x.shape[1:], x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strd, pad)
+        return s / cnt
+    k = 1
+    for d in kernel:
+        k *= d
+    return s / k
+
+
+@register_op("pnormpool2d")
+def pnormpool2d(x, *, kernel=(2, 2), strides=None, padding="valid", pnorm=2):
+    strides = strides or kernel
+    s = lax.reduce_window(
+        jnp.abs(x) ** pnorm,
+        0.0,
+        lax.add,
+        (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,),
+        _pool_pad(padding, 2),
+    )
+    return s ** (1.0 / pnorm)
+
+
+@register_op("maxpool3d")
+def maxpool3d(x, *, kernel=(2, 2, 2), strides=None, padding="valid"):
+    strides = strides or kernel
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,),
+        "SAME" if (isinstance(padding, str) and padding.lower() == "same") else "VALID",
+    )
+
+
+@register_op("avgpool3d")
+def avgpool3d(x, *, kernel=(2, 2, 2), strides=None, padding="valid"):
+    strides = strides or kernel
+    s = lax.reduce_window(
+        x, 0.0, lax.add,
+        (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,),
+        "SAME" if (isinstance(padding, str) and padding.lower() == "same") else "VALID",
+    )
+    k = 1
+    for d in kernel:
+        k *= d
+    return s / k
+
+
+@register_op("lrn")
+def lrn(x, *, depth=5, alpha=1e-4, beta=0.75, k=2.0):
+    """Local response normalization across channels (NHWC).
+
+    Reference: libnd4j lrn op / CudnnLocalResponseNormalizationHelper.
+    """
+    half = depth // 2
+    sq = x * x
+    # sum over a sliding channel window via padded cumulative trick
+    pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    windows = [pad[..., i : i + x.shape[-1]] for i in range(depth)]
+    ssum = sum(windows)
+    return x / (k + alpha * ssum) ** beta
+
+
+@register_op("upsampling2d")
+def upsampling2d(x, *, size=(2, 2)):
+    return jnp.repeat(jnp.repeat(x, size[0], axis=1), size[1], axis=2)
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, *, block=2):
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // block, w // block, c * block * block)
+
+
+@register_op("depth_to_space")
+def depth_to_space(x, *, block=2):
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, block, block, c // (block * block))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h * block, w * block, c // (block * block))
+
+
+def conv_out_len(n, k, s, pad, dilation=1):
+    """Output spatial length (DL4J ConvolutionUtils.getOutputSize semantics)."""
+    if n is None:
+        return None
+    eff = (k - 1) * dilation + 1
+    if isinstance(pad, str) and pad.lower() == "same":
+        return -(-n // s)
+    p = 0 if isinstance(pad, str) else int(pad)
+    return (n + 2 * p - eff) // s + 1

@@ -1,0 +1,105 @@
+"""Recurrent cell ops (scan-based XLA lowerings).
+
+Reference analog: libnd4j lstmLayer/lstmBlock/gruCell declarable ops
+(libnd4j/include/ops/declarable/generic/nn/recurrent/**) and the
+CudnnLSTMHelper fused kernels (deeplearning4j-cuda ::
+org.deeplearning4j.nn.layers.recurrent.CudnnLSTMHelper).
+
+TPU-first design: the input projection x@W for ALL timesteps is hoisted out
+of the recurrence into one large batched matmul (MXU-shaped, [B*T, F]x[F,4H]);
+only the irreducibly-sequential h@R recurrence runs inside ``lax.scan``. That
+is the same split cuDNN's persistent-RNN kernels make. Gate order is IFOG
+(input, forget, output, cell-candidate) throughout.
+
+Layouts: x [B, T, F] (time axis 1), h/c [B, H].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("lstm_layer")
+def lstm_layer(x, h0, c0, W, R, b, *, peephole=None, forget_gate_bias=0.0, reverse=False):
+    """Full-sequence LSTM.
+
+    x [B,T,F], W [F,4H], R [H,4H], b [4H], peephole None or [3H] (i,f,o —
+    GravesLSTM peephole connections). Returns (outputs [B,T,H], (hT, cT)).
+    """
+    H = R.shape[0]
+    xg = x @ W + b  # [B, T, 4H] — one big MXU matmul
+    if forget_gate_bias:
+        xg = xg.at[..., H : 2 * H].add(forget_gate_bias)
+    xg = jnp.swapaxes(xg, 0, 1)  # [T, B, 4H] scan-major
+    if reverse:
+        xg = jnp.flip(xg, axis=0)
+
+    if peephole is not None:
+        p_i, p_f, p_o = peephole[:H], peephole[H : 2 * H], peephole[2 * H :]
+
+    def step(carry, g):
+        h, c = carry
+        g = g + h @ R
+        i, f, o, z = g[..., :H], g[..., H : 2 * H], g[..., 2 * H : 3 * H], g[..., 3 * H :]
+        if peephole is not None:
+            i = i + c * p_i
+            f = f + c * p_f
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        z = jnp.tanh(z)
+        c_new = f * c + i * z
+        if peephole is not None:
+            o = o + c_new * p_o
+        o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), xg)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return jnp.swapaxes(ys, 0, 1), (hT, cT)
+
+
+@register_op("gru_layer")
+def gru_layer(x, h0, W, R, b, *, reverse=False):
+    """Full-sequence GRU. W [F,3H], R [H,3H], b [3H]; gate order r,z,n."""
+    H = R.shape[0]
+    xg = x @ W + b
+    xg = jnp.swapaxes(xg, 0, 1)
+    if reverse:
+        xg = jnp.flip(xg, axis=0)
+
+    def step(h, g):
+        hg = h @ R
+        r = jax.nn.sigmoid(g[..., :H] + hg[..., :H])
+        z = jax.nn.sigmoid(g[..., H : 2 * H] + hg[..., H : 2 * H])
+        n = jnp.tanh(g[..., 2 * H :] + r * hg[..., 2 * H :])
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    hT, ys = lax.scan(step, h0, xg)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+@register_op("simple_rnn_layer")
+def simple_rnn_layer(x, h0, W, R, b, *, activation=jnp.tanh, reverse=False):
+    """Elman RNN: h_t = act(x_t@W + h_{t-1}@R + b)."""
+    xg = x @ W + b
+    xg = jnp.swapaxes(xg, 0, 1)
+    if reverse:
+        xg = jnp.flip(xg, axis=0)
+
+    def step(h, g):
+        h_new = activation(g + h @ R)
+        return h_new, h_new
+
+    hT, ys = lax.scan(step, h0, xg)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return jnp.swapaxes(ys, 0, 1), hT

@@ -1,0 +1,37 @@
+"""Training machinery: updaters, schedules, listeners, early stopping.
+
+Reference analog: org.nd4j.linalg.learning (IUpdater impls),
+org.nd4j.linalg.schedule (ISchedule), org.deeplearning4j.optimize
+(Solver, listeners), org.deeplearning4j.earlystopping.
+"""
+
+from deeplearning4j_tpu.optimize.updaters import (
+    Sgd, Adam, AdamW, AdaMax, Nadam, Nesterovs, RMSProp, AdaGrad, AdaDelta,
+    AMSGrad, NoOp, get_updater, updater_from_dict,
+)
+from deeplearning4j_tpu.optimize.schedules import (
+    ConstantSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+    SigmoidSchedule, StepSchedule, MapSchedule, WarmupCosineSchedule, resolve_schedule,
+)
+from deeplearning4j_tpu.optimize.listeners import (
+    TrainingListener, ScoreIterationListener, PerformanceListener,
+    EvaluativeListener, CheckpointListener, CollectScoresListener,
+)
+from deeplearning4j_tpu.optimize.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, EarlyStoppingResult,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition, MaxTimeIterationTerminationCondition,
+)
+
+__all__ = [
+    "Sgd", "Adam", "AdamW", "AdaMax", "Nadam", "Nesterovs", "RMSProp", "AdaGrad",
+    "AdaDelta", "AMSGrad", "NoOp", "get_updater", "updater_from_dict",
+    "ConstantSchedule", "ExponentialSchedule", "InverseSchedule", "PolySchedule",
+    "SigmoidSchedule", "StepSchedule", "MapSchedule", "WarmupCosineSchedule",
+    "resolve_schedule",
+    "TrainingListener", "ScoreIterationListener", "PerformanceListener",
+    "EvaluativeListener", "CheckpointListener", "CollectScoresListener",
+    "EarlyStoppingConfiguration", "EarlyStoppingTrainer", "EarlyStoppingResult",
+    "MaxEpochsTerminationCondition", "ScoreImprovementEpochTerminationCondition",
+    "MaxScoreIterationTerminationCondition", "MaxTimeIterationTerminationCondition",
+]

@@ -1,0 +1,142 @@
+"""Learning-rate schedules.
+
+Reference analog: org.nd4j.linalg.schedule.ISchedule and impls
+(ExponentialSchedule, InverseSchedule, PolySchedule, SigmoidSchedule,
+StepSchedule, MapSchedule; ScheduleType ITERATION/EPOCH). All are pure
+functions of the (traced) step counter so they compile into the train step —
+no host-side LR updates. WarmupCosine is net-new (transformer training).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+SCHEDULE_REGISTRY: dict[str, type] = {}
+
+
+def _register(cls):
+    SCHEDULE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@type"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        return SCHEDULE_REGISTRY[d.pop("@type")](**d)
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class ConstantSchedule(Schedule):
+    value: float = 1e-3
+
+    def __call__(self, step):
+        return self.value
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class ExponentialSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+
+    def __call__(self, step):
+        return self.initial_value * self.gamma**step
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class InverseSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.1
+    power: float = 1.0
+
+    def __call__(self, step):
+        return self.initial_value / (1.0 + self.gamma * step) ** self.power
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class PolySchedule(Schedule):
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def __call__(self, step):
+        frac = jnp.clip(step / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class SigmoidSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.1
+    step_size: int = 1000
+
+    def __call__(self, step):
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (step - self.step_size)))
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class StepSchedule(Schedule):
+    initial_value: float = 1e-3
+    decay_rate: float = 0.5
+    step_size: int = 1000
+
+    def __call__(self, step):
+        return self.initial_value * self.decay_rate ** jnp.floor(step / self.step_size)
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class MapSchedule(Schedule):
+    """Piecewise-constant LR keyed by step (org.nd4j.linalg.schedule.MapSchedule)."""
+
+    values: tuple = ((0, 1e-3),)  # sorted (step, lr) pairs
+
+    def __call__(self, step):
+        lr = jnp.asarray(self.values[0][1])
+        for s, v in self.values:
+            lr = jnp.where(step >= s, v, lr)
+        return lr
+
+
+@_register
+@dataclasses.dataclass(frozen=True)
+class WarmupCosineSchedule(Schedule):
+    """Linear warmup then cosine decay — net-new, transformer standard."""
+
+    peak_value: float = 1e-3
+    warmup_steps: int = 1000
+    total_steps: int = 100000
+    end_value: float = 0.0
+
+    def __call__(self, step):
+        warm = self.peak_value * step / max(self.warmup_steps, 1)
+        frac = jnp.clip((step - self.warmup_steps) / max(self.total_steps - self.warmup_steps, 1),
+                        0.0, 1.0)
+        cos = self.end_value + 0.5 * (self.peak_value - self.end_value) * (
+            1.0 + jnp.cos(math.pi * frac))
+        return jnp.where(step < self.warmup_steps, warm, cos)
+
+
+def resolve_schedule(lr) -> Schedule:
+    """Accept a float (constant) or a Schedule."""
+    if isinstance(lr, Schedule):
+        return lr
+    return ConstantSchedule(float(lr))

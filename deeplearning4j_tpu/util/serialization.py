@@ -1,0 +1,132 @@
+"""Model serialization — zip checkpoints.
+
+Reference analog: org.deeplearning4j.util.ModelSerializer — a model saves as a
+zip of ``configuration.json`` + ``coefficients.bin`` (flat params) +
+``updaterState.bin``. Same layout here with npz payloads:
+
+    configuration.json   - the network config (JSON round-trip)
+    coefficients.npz     - trainable params, flat-named arrays
+    state.npz            - non-trainable state (BN running stats, ...)
+    updater.npz          - optimizer state
+    meta.json            - model class, step/epoch counters, format version
+
+Orbax-style async sharded checkpointing for large distributed models lives in
+``parallel.checkpoint``; this zip format is the interchange/export path.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+FORMAT_VERSION = 1
+
+
+def _flatten(tree, prefix=""):
+    """Flatten a nested list/dict pytree into {path: array}."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten_into(template, flat):
+    """Rebuild arrays into the same structure as ``template``."""
+
+    def rebuild(t, prefix=""):
+        if isinstance(t, dict):
+            return {k: rebuild(v, f"{prefix}{k}/") for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            seq = [rebuild(v, f"{prefix}{i}/") for i, v in enumerate(t)]
+            return type(t)(seq) if isinstance(t, tuple) else seq
+        if t is None:
+            return None
+        key = prefix.rstrip("/")
+        return jnp.asarray(flat[key])
+
+    return rebuild(template)
+
+
+def _npz_bytes(flat: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def _npz_load(b: bytes) -> dict:
+    return dict(np.load(io.BytesIO(b)))
+
+
+def write_model(model, path: str, save_updater: bool = True):
+    """ModelSerializer.writeModel analog."""
+    is_graph = hasattr(model.conf, "vertices")
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "model_class": "ComputationGraph" if is_graph else "MultiLayerNetwork",
+        "step_count": model.step_count,
+        "epoch_count": model.epoch_count,
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", model.conf.to_json())
+        z.writestr("coefficients.npz", _npz_bytes(_flatten(model.params)))
+        z.writestr("state.npz", _npz_bytes(_flatten(model.state)))
+        if save_updater:
+            z.writestr("updater.npz", _npz_bytes(_flatten(model.opt_state)))
+        z.writestr("meta.json", json.dumps(meta))
+
+
+def _restore(path: str, model_factory, conf_parser, load_updater: bool):
+    with zipfile.ZipFile(path) as z:
+        conf = conf_parser(z.read("configuration.json").decode())
+        meta = json.loads(z.read("meta.json").decode())
+        model = model_factory(conf).init(conf.seed)
+        coeffs = _npz_load(z.read("coefficients.npz"))
+        model.params = _unflatten_into(model.params, coeffs)
+        states = _npz_load(z.read("state.npz"))
+        if states:
+            model.state = _unflatten_into(model.state, states)
+        if load_updater and "updater.npz" in z.namelist():
+            upd = _npz_load(z.read("updater.npz"))
+            if upd:
+                model.opt_state = _unflatten_into(model.opt_state, upd)
+        model.step_count = meta.get("step_count", 0)
+        model.epoch_count = meta.get("epoch_count", 0)
+    return model
+
+
+def restore_multi_layer_network(path: str, load_updater: bool = True):
+    """ModelSerializer.restoreMultiLayerNetwork analog."""
+    from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    return _restore(path, MultiLayerNetwork, MultiLayerConfiguration.from_json, load_updater)
+
+
+def restore_computation_graph(path: str, load_updater: bool = True):
+    """ModelSerializer.restoreComputationGraph analog."""
+    from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    return _restore(path, ComputationGraph, ComputationGraphConfiguration.from_json, load_updater)
+
+
+def restore_model(path: str, load_updater: bool = True):
+    """Auto-detect model class from meta.json."""
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("meta.json").decode())
+    if meta["model_class"] == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)

@@ -1,0 +1,183 @@
+"""MultiLayerNetwork end-to-end tests.
+
+Reference analog of deeplearning4j-core's MultiLayerTest: tiny synthetic
+data, check fit reduces loss, output shapes, JSON round-trip, save/load.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import (
+    InputType, MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer, ConvolutionLayer, DenseLayer, OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.optimize import Adam
+
+
+def _toy_classification(rng, n=128, nin=10, classes=3):
+    x = rng.normal(size=(n, nin)).astype(np.float32)
+    w = rng.normal(size=(nin, classes))
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n, classes)), axis=1)
+    onehot = np.eye(classes, dtype=np.float32)[y]
+    return x, onehot
+
+
+def _mlp_conf(nin=10, classes=3, seed=42):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(lr=1e-2))
+        .list()
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=classes, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(nin))
+        .build()
+    )
+
+
+class TestMLP:
+    def test_shapes_and_loss_decreases(self, rng):
+        x, y = _toy_classification(rng)
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        out = model.output(x)
+        assert out.shape == (128, 3)
+        assert np.allclose(np.asarray(out).sum(axis=1), 1.0, atol=1e-5)
+
+        first = model.fit_batch((x, y))
+        for _ in range(60):
+            last = model.fit_batch((x, y))
+        assert last < first * 0.7, f"loss did not decrease: {first} -> {last}"
+
+    def test_num_params(self):
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        assert model.num_params() == (10 * 32 + 32) + (32 * 16 + 16) + (16 * 3 + 3)
+
+    def test_params_table_naming(self):
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        table = model.params_table()
+        assert "0_W" in table and "0_b" in table and "2_W" in table
+        assert table["0_W"].shape == (10, 32)
+
+    def test_deterministic_init(self):
+        m1 = MultiLayerNetwork(_mlp_conf(seed=7)).init()
+        m2 = MultiLayerNetwork(_mlp_conf(seed=7)).init()
+        np.testing.assert_array_equal(np.asarray(m1.params[0]["W"]),
+                                      np.asarray(m2.params[0]["W"]))
+
+    def test_evaluate(self, rng):
+        x, y = _toy_classification(rng)
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        for _ in range(80):
+            model.fit_batch((x, y))
+        ev = model.evaluate([(x, y)])
+        assert ev.accuracy() > 0.8
+        assert ev.num_examples() == 128
+        assert 0.0 <= ev.f1() <= 1.0
+
+
+class TestJsonRoundTrip:
+    def test_mlp_roundtrip(self):
+        conf = _mlp_conf()
+        s = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(s)
+        assert len(conf2.layers) == 3
+        assert conf2.layers[0].n_out == 32
+        assert conf2.layers[0].activation == "relu"
+        assert type(conf2.updater).__name__ == "Adam"
+        assert conf2.to_json() == s
+
+    def test_cnn_roundtrip(self):
+        conf = _lenet_conf()
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.layers[0].kernel == (5, 5)
+        m = MultiLayerNetwork(conf2).init()
+        assert m.num_params() > 0
+
+
+def _lenet_conf(seed=12345):
+    """The LeNet-MNIST config (BASELINE.json config #1) at test scale."""
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(lr=1e-3))
+        .list()
+        .layer(ConvolutionLayer(n_out=8, kernel=(5, 5), activation="identity"))
+        .layer(SubsamplingLayer(kernel=(2, 2), strides=(2, 2), pooling_type="max"))
+        .layer(ConvolutionLayer(n_out=16, kernel=(5, 5), activation="identity"))
+        .layer(SubsamplingLayer(kernel=(2, 2), strides=(2, 2), pooling_type="max"))
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+
+
+class TestLeNet:
+    def test_shapes(self, rng):
+        model = MultiLayerNetwork(_lenet_conf()).init()
+        x = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+        out = model.output(x)
+        assert out.shape == (4, 10)
+
+    def test_accepts_flat_and_nchw(self, rng):
+        model = MultiLayerNetwork(_lenet_conf()).init()
+        x = rng.normal(size=(4, 28, 28, 1)).astype(np.float32)
+        out_nhwc = np.asarray(model.output(x))
+        out_flat = np.asarray(model.output(x.reshape(4, 784)))
+        np.testing.assert_allclose(out_nhwc, out_flat, rtol=1e-5)
+
+    def test_fit_decreases_loss(self, rng):
+        model = MultiLayerNetwork(_lenet_conf()).init()
+        x = rng.normal(size=(32, 28, 28, 1)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+        first = model.fit_batch((x, y))
+        for _ in range(30):
+            last = model.fit_batch((x, y))
+        assert last < first
+
+
+class TestBatchNorm:
+    def test_running_stats_update(self, rng):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1)
+            .updater(Adam(lr=1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="identity"))
+            .layer(BatchNormalizationLayer())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5))
+            .build()
+        )
+        model = MultiLayerNetwork(conf).init()
+        before = np.asarray(model.state[1]["mean"]).copy()
+        x = (5.0 + rng.normal(size=(64, 5))).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        model.fit_batch((x, y))
+        after = np.asarray(model.state[1]["mean"])
+        assert not np.allclose(before, after), "BN running mean should move during training"
+
+
+class TestSaveLoad:
+    def test_zip_roundtrip(self, rng, tmp_path):
+        x, y = _toy_classification(rng)
+        model = MultiLayerNetwork(_mlp_conf()).init()
+        model.fit_batch((x, y))
+        path = str(tmp_path / "model.zip")
+        model.save(path)
+        loaded = MultiLayerNetwork.load(path)
+        np.testing.assert_allclose(
+            np.asarray(model.output(x)), np.asarray(loaded.output(x)), rtol=1e-6
+        )
+        assert loaded.step_count == model.step_count
+        # updater state restored: continuing training matches
+        l1 = model.fit_batch((x, y))
+        l2 = loaded.fit_batch((x, y))
+        assert abs(l1 - l2) < 1e-5
