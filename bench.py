@@ -1,0 +1,146 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput (samples/sec/chip).
+
+The BASELINE.json north-star metric, measured from the framework's own
+model-zoo entrypoint, with an in-process JAX/Flax-style reference ResNet-50
+train step measured the same way to compute ``vs_baseline`` (target >= 0.70
+of the reference's samples/sec/chip).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _measure(step_fn, args, warmup=3, iters=10):
+    import jax
+
+    for _ in range(warmup):
+        args = step_fn(*args)
+    jax.block_until_ready(args)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        args = step_fn(*args)
+    jax.block_until_ready(args)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_ours(batch):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    model = ResNet50(height=224, width=224, num_classes=1000, dtype="bf16").init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+
+    step = model._jit_cache.get("train") or model._make_train_step()
+    key = jax.random.key(0)
+
+    def one(params, state, opt_state, i):
+        p, s, o, loss = step(params, state, opt_state, i, {"input": x},
+                             {"output": y}, key, None)
+        return p, s, o, i + 1
+
+    args = (model.params, model.state, model.opt_state, jnp.asarray(0, jnp.int32))
+    dt = _measure(one, args)
+    return batch / dt
+
+
+def bench_flax_reference(batch):
+    """Minimal Flax ResNet-50 train step, same shapes/dtype policy."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    class Bottleneck(nn.Module):
+        width: int
+        stride: int = 1
+        project: bool = False
+
+        @nn.compact
+        def __call__(self, x, train=True):
+            conv = lambda f, k, s: nn.Conv(f, (k, k), (s, s), padding="SAME",
+                                           use_bias=False, dtype=jnp.bfloat16)
+            bn = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                      dtype=jnp.bfloat16)
+            h = nn.relu(bn()(conv(self.width, 1, self.stride)(x)))
+            h = nn.relu(bn()(conv(self.width, 3, 1)(h)))
+            h = bn()(conv(self.width * 4, 1, 1)(h))
+            if self.project:
+                x = bn()(conv(self.width * 4, 1, self.stride)(x))
+            return nn.relu(h + x)
+
+    class ResNet50F(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(64, (7, 7), (2, 2), padding="SAME", use_bias=False,
+                        dtype=jnp.bfloat16)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             dtype=jnp.bfloat16)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+            for si, (w, n, s) in enumerate([(64, 3, 1), (128, 4, 2), (256, 6, 2),
+                                            (512, 3, 2)]):
+                for bi in range(n):
+                    x = Bottleneck(w, s if bi == 0 else 1, project=(bi == 0))(x, train)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(1000, dtype=jnp.bfloat16)(x)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 1000, batch))
+    m = ResNet50F()
+    variables = m.init(jax.random.key(0), x[:1], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt = tx.init(params)
+
+    @jax.jit
+    def one(params, batch_stats, opt, i):
+        def loss_fn(p):
+            logits, upd = m.apply({"params": p, "batch_stats": batch_stats}, x,
+                                  train=True, mutable=["batch_stats"])
+            ll = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels).mean()
+            return ll, upd["batch_stats"]
+
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), bs, opt, i + 1
+
+    dt = _measure(one, (params, batch_stats, opt, jnp.asarray(0)))
+    return batch / dt
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    try:
+        ours = bench_ours(batch)
+    except Exception as e:  # OOM fallback
+        batch = batch // 2
+        ours = bench_ours(batch)
+    try:
+        ref = bench_flax_reference(batch)
+        vs = ours / ref
+    except Exception:
+        ref, vs = None, None
+    print(json.dumps({
+        "metric": "ResNet-50 ImageNet train throughput (zoo entrypoint, bf16, batch %d)" % batch,
+        "value": round(ours, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": None if vs is None else round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Autodiff utilities: gradient checking + SameDiff-style graph API.
+
+Reference analog: org.nd4j.autodiff.** (SameDiff define-then-run graphs,
+validation.OpValidation, GradCheckUtil).
+"""
+
+from deeplearning4j_tpu.autodiff.gradcheck import grad_check, grad_check_model
+
+__all__ = ["grad_check", "grad_check_model"]

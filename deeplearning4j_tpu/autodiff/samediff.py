@@ -1,0 +1,337 @@
+"""SameDiff — define-then-run autodiff graphs.
+
+Reference analog: nd4j-api :: org.nd4j.autodiff.samediff.SameDiff /
+SDVariable / DifferentialFunction, with InferenceSession/TrainingSession
+executing ops one-by-one through the executioner (SURVEY.md §3.4).
+
+TPU-first redesign: the user builds the same symbolic graph (placeholders,
+variables, op calls returning SDVariable), but execution traces the whole
+graph into ONE jitted XLA program — define-then-run maps 1:1 onto
+trace-and-compile, so there is no per-op dispatch loop at runtime at all.
+Gradients come from jax.grad over the traced function (the reference builds
+an explicit backward graph; XLA's autodiff is the same construction done by
+the compiler).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SDVariable:
+    """Symbolic handle into a SameDiff graph (org.nd4j.autodiff.samediff.SDVariable)."""
+
+    sd: "SameDiff"
+    name: str
+
+    # -- operator sugar; every op routes through sd._op --
+    def __add__(self, o):
+        return self.sd._op("add", jnp.add, self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self.sd._op("sub", jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return self.sd._op("rsub", lambda a, b: b - a, self, o)
+
+    def __mul__(self, o):
+        return self.sd._op("mul", jnp.multiply, self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self.sd._op("div", jnp.divide, self, o)
+
+    def __neg__(self):
+        return self.sd._op("neg", jnp.negative, self)
+
+    def __matmul__(self, o):
+        return self.sd.mmul(self, o)
+
+    # common shortcuts
+    def sum(self, axis=None, keepdims=False):
+        return self.sd._op("sum", lambda a: jnp.sum(a, axis=axis, keepdims=keepdims), self)
+
+    def mean(self, axis=None, keepdims=False):
+        return self.sd._op("mean", lambda a: jnp.mean(a, axis=axis, keepdims=keepdims), self)
+
+    def reshape(self, *shape):
+        return self.sd._op("reshape", lambda a: jnp.reshape(a, shape), self)
+
+    def transpose(self, *axes):
+        return self.sd._op("transpose", lambda a: jnp.transpose(a, axes or None), self)
+
+    def eval(self, **placeholders):
+        return self.sd.output(self.name, **placeholders)
+
+
+@dataclasses.dataclass
+class _Node:
+    name: str
+    kind: str  # "placeholder" | "variable" | "constant" | "op"
+    fn: Optional[Callable] = None
+    inputs: tuple = ()
+    value: Any = None  # for variable/constant: concrete array
+    shape: Optional[tuple] = None
+
+
+class SameDiff:
+    """The graph container (org.nd4j.autodiff.samediff.SameDiff.create())."""
+
+    def __init__(self, seed: int = 0):
+        self._nodes: dict[str, _Node] = {}
+        self._counter = 0
+        self._key = jax.random.key(seed)
+        self.loss_name: Optional[str] = None
+        self._jit_cache: dict = {}
+
+    @staticmethod
+    def create(seed: int = 0) -> "SameDiff":
+        return SameDiff(seed)
+
+    # ------------------------------------------------------------- builders
+    def _fresh(self, base: str) -> str:
+        self._counter += 1
+        return f"{base}_{self._counter}"
+
+    def _add(self, node: _Node) -> SDVariable:
+        self._nodes[node.name] = node
+        self._jit_cache.clear()
+        return SDVariable(self, node.name)
+
+    def placeholder(self, name: str, shape=None, dtype=jnp.float32) -> SDVariable:
+        return self._add(_Node(name, "placeholder", shape=shape))
+
+    def var(self, name: str, init, shape=None) -> SDVariable:
+        """Trainable variable: init = array, or a weight-init scheme name."""
+        if isinstance(init, str):
+            from deeplearning4j_tpu.nn.weights import init_weight
+
+            self._key, sub = jax.random.split(self._key)
+            value = init_weight(sub, shape, init)
+        else:
+            value = jnp.asarray(init)
+        return self._add(_Node(name, "variable", value=value))
+
+    def constant(self, value, name: Optional[str] = None) -> SDVariable:
+        name = name or self._fresh("const")
+        return self._add(_Node(name, "constant", value=jnp.asarray(value)))
+
+    def _op(self, base: str, fn: Callable, *args, name: Optional[str] = None) -> SDVariable:
+        inputs = []
+        for a in args:
+            if isinstance(a, SDVariable):
+                inputs.append(a.name)
+            else:
+                c = self.constant(a)
+                inputs.append(c.name)
+        name = name or self._fresh(base)
+        return self._add(_Node(name, "op", fn=fn, inputs=tuple(inputs)))
+
+    # ---------------------------------------------------------- op catalog
+    # (mirrors SDBaseOps/SDNN/SDMath method surface; each is one XLA op)
+    def mmul(self, a, b, name=None):
+        return self._op("mmul", jnp.matmul, a, b, name=name)
+
+    def add(self, a, b, name=None):
+        return self._op("add", jnp.add, a, b, name=name)
+
+    def sub(self, a, b, name=None):
+        return self._op("sub", jnp.subtract, a, b, name=name)
+
+    def mul(self, a, b, name=None):
+        return self._op("mul", jnp.multiply, a, b, name=name)
+
+    def div(self, a, b, name=None):
+        return self._op("div", jnp.divide, a, b, name=name)
+
+    def exp(self, a, name=None):
+        return self._op("exp", jnp.exp, a, name=name)
+
+    def log(self, a, name=None):
+        return self._op("log", jnp.log, a, name=name)
+
+    def sqrt(self, a, name=None):
+        return self._op("sqrt", jnp.sqrt, a, name=name)
+
+    def square(self, a, name=None):
+        return self._op("square", jnp.square, a, name=name)
+
+    def abs(self, a, name=None):
+        return self._op("abs", jnp.abs, a, name=name)
+
+    def tanh(self, a, name=None):
+        return self._op("tanh", jnp.tanh, a, name=name)
+
+    def sigmoid(self, a, name=None):
+        return self._op("sigmoid", jax.nn.sigmoid, a, name=name)
+
+    def relu(self, a, name=None):
+        return self._op("relu", jax.nn.relu, a, name=name)
+
+    def softmax(self, a, axis=-1, name=None):
+        return self._op("softmax", lambda x: jax.nn.softmax(x, axis=axis), a, name=name)
+
+    def log_softmax(self, a, axis=-1, name=None):
+        return self._op("log_softmax", lambda x: jax.nn.log_softmax(x, axis=axis), a,
+                        name=name)
+
+    def conv2d(self, x, w, strides=(1, 1), padding="same", name=None):
+        from deeplearning4j_tpu.ops.convolution import conv2d as _c
+
+        return self._op("conv2d", lambda a, b: _c(a, b, strides=strides, padding=padding),
+                        x, w, name=name)
+
+    def batch_matmul(self, a, b, name=None):
+        return self._op("bmm", jnp.matmul, a, b, name=name)
+
+    def sum(self, a, axis=None, keepdims=False, name=None):
+        return self._op("sum", lambda x: jnp.sum(x, axis=axis, keepdims=keepdims), a,
+                        name=name)
+
+    def mean(self, a, axis=None, keepdims=False, name=None):
+        return self._op("mean", lambda x: jnp.mean(x, axis=axis, keepdims=keepdims), a,
+                        name=name)
+
+    def max(self, a, axis=None, keepdims=False, name=None):
+        return self._op("max", lambda x: jnp.max(x, axis=axis, keepdims=keepdims), a,
+                        name=name)
+
+    def concat(self, vars, axis=-1, name=None):
+        return self._op("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *vars,
+                        name=name)
+
+    def cross_entropy(self, labels, logits, name=None):
+        def ce(y, z):
+            return -(y * jax.nn.log_softmax(z, -1)).sum(-1).mean()
+
+        return self._op("softmax_ce", ce, labels, logits, name=name)
+
+    def mse(self, labels, pred, name=None):
+        return self._op("mse", lambda y, p: ((y - p) ** 2).mean(), labels, pred, name=name)
+
+    # ------------------------------------------------------------ execution
+    def _topo(self, targets: list[str]) -> list[str]:
+        order, seen = [], set()
+
+        def visit(n):
+            if n in seen:
+                return
+            seen.add(n)
+            for d in self._nodes[n].inputs:
+                visit(d)
+            order.append(n)
+
+        for t in targets:
+            visit(t)
+        return order
+
+    def _build_fn(self, targets: list[str]):
+        """Compile the graph into fn(variables_dict, placeholders_dict) -> outputs."""
+        order = self._topo(targets)
+
+        def fn(variables, placeholders):
+            env = {}
+            for n in order:
+                node = self._nodes[n]
+                if node.kind == "placeholder":
+                    env[n] = placeholders[n]
+                elif node.kind == "variable":
+                    env[n] = variables[n]
+                elif node.kind == "constant":
+                    env[n] = node.value
+                else:
+                    env[n] = node.fn(*[env[i] for i in node.inputs])
+            return [env[t] for t in targets]
+
+        return fn
+
+    def variables(self) -> dict:
+        return {n: nd.value for n, nd in self._nodes.items() if nd.kind == "variable"}
+
+    def set_variables(self, values: dict):
+        for n, v in values.items():
+            self._nodes[n].value = v
+
+    def output(self, *targets: str, **placeholders):
+        """Execute (InferenceSession.output analog) — one jitted program."""
+        targets = [t.name if isinstance(t, SDVariable) else t for t in targets]
+        key = ("out", tuple(targets))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(self._build_fn(list(targets)))
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        outs = self._jit_cache[key](self.variables(), ph)
+        return outs[0] if len(outs) == 1 else outs
+
+    def grad(self, loss: str | SDVariable, wrt: Optional[list] = None, **placeholders):
+        """Gradients of a scalar loss node wrt variables (createGradFunction)."""
+        loss = loss.name if isinstance(loss, SDVariable) else loss
+        fn = self._build_fn([loss])
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        g = jax.grad(lambda vs: fn(vs, ph)[0])(self.variables())
+        if wrt is not None:
+            wrt = [w.name if isinstance(w, SDVariable) else w for w in wrt]
+            return {n: g[n] for n in wrt}
+        return g
+
+    # ------------------------------------------------------------- training
+    def set_loss(self, loss: str | SDVariable):
+        self.loss_name = loss.name if isinstance(loss, SDVariable) else loss
+        return self
+
+    def fit(self, updater=None, steps: int = 1, listeners=(), **placeholders) -> float:
+        """TrainingSession analog: jitted step = loss + grads + updater apply."""
+        from deeplearning4j_tpu.optimize.updaters import Sgd, get_updater
+
+        if self.loss_name is None:
+            raise ValueError("call set_loss() first")
+        updater = get_updater(updater) if updater is not None else Sgd(lr=1e-2)
+        fn = self._build_fn([self.loss_name])
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+
+        key = ("fit", id(updater))
+        if key not in self._jit_cache:
+            @jax.jit
+            def step(variables, opt_state, i, ph):
+                loss, grads = jax.value_and_grad(lambda vs: fn(vs, ph)[0])(variables)
+                upd, opt_state = updater.update(grads, opt_state, variables, i)
+                new_vars = jax.tree_util.tree_map(lambda v, d: v - d, variables, upd)
+                return new_vars, opt_state, loss
+
+            self._jit_cache[key] = step
+        step_fn = self._jit_cache[key]
+
+        variables = self.variables()
+        opt_state = updater.init_state(variables)
+        loss = np.nan
+        for i in range(steps):
+            variables, opt_state, loss = step_fn(variables, opt_state,
+                                                 jnp.asarray(i, jnp.int32), ph)
+            for lst in listeners:
+                lst.iteration_done(self, i, 0, float(loss))
+        self.set_variables(variables)
+        return float(loss)
+
+    # ---------------------------------------------------------------- serde
+    def save(self, path: str):
+        """FlatBuffers .fb analog: npz of variables + graph metadata pickle-free."""
+        import json as _json
+        import zipfile
+
+        meta = {n: {"kind": d.kind, "inputs": list(d.inputs)}
+                for n, d in self._nodes.items()}
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("graph.json", _json.dumps(meta))
+            import io
+
+            buf = io.BytesIO()
+            np.savez(buf, **{n: np.asarray(v) for n, v in self.variables().items()})
+            z.writestr("variables.npz", buf.getvalue())

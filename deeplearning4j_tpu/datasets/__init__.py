@@ -1,0 +1,22 @@
+"""Data pipeline: DataSet, iterators, normalizers, fetchers.
+
+Reference analog: org.nd4j.linalg.dataset (DataSet, normalizers,
+DataSetIterator contract), deeplearning4j-data (MnistDataSetIterator etc.),
+datavec ETL. Host-side numpy with async device prefetch — the TPU analog of
+DL4J's AsyncDataSetIterator prefetch thread.
+"""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    DataSetIterator, ListDataSetIterator, ArrayDataSetIterator, AsyncPrefetchIterator,
+)
+from deeplearning4j_tpu.datasets.normalizers import (
+    NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
+)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+
+__all__ = [
+    "DataSet", "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
+    "AsyncPrefetchIterator", "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler", "MnistDataSetIterator",
+]

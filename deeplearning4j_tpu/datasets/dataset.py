@@ -1,0 +1,79 @@
+"""DataSet — a (features, labels, masks) batch.
+
+Reference analog: org.nd4j.linalg.dataset.DataSet (features, labels,
+featuresMaskArray, labelsMaskArray; save/load, shuffle, splitTestAndTrain,
+batchBy). Host-side numpy; conversion to device arrays happens at the jit
+boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        return DataSet(
+            self.features[idx], self.labels[idx],
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx],
+        )
+
+    def split_test_and_train(self, n_train: int):
+        """Returns (train, test) (DataSet.splitTestAndTrain)."""
+        tr = DataSet(
+            self.features[:n_train], self.labels[:n_train],
+            None if self.features_mask is None else self.features_mask[:n_train],
+            None if self.labels_mask is None else self.labels_mask[:n_train],
+        )
+        te = DataSet(
+            self.features[n_train:], self.labels[n_train:],
+            None if self.features_mask is None else self.features_mask[n_train:],
+            None if self.labels_mask is None else self.labels_mask[n_train:],
+        )
+        return tr, te
+
+    def batch_by(self, batch_size: int) -> list["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            out.append(DataSet(
+                self.features[i : i + batch_size], self.labels[i : i + batch_size],
+                None if self.features_mask is None else self.features_mask[i : i + batch_size],
+                None if self.labels_mask is None else self.labels_mask[i : i + batch_size],
+            ))
+        return out
+
+    def save(self, path: str):
+        arrays = {"features": self.features, "labels": self.labels}
+        if self.features_mask is not None:
+            arrays["features_mask"] = self.features_mask
+        if self.labels_mask is not None:
+            arrays["labels_mask"] = self.labels_mask
+        np.savez_compressed(path, **arrays)
+
+    @staticmethod
+    def load(path: str) -> "DataSet":
+        d = np.load(path)
+        return DataSet(d["features"], d["labels"],
+                       d.get("features_mask"), d.get("labels_mask"))
+
+    @staticmethod
+    def merge(datasets: list["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]),
+        )

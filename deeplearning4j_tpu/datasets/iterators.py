@@ -1,0 +1,124 @@
+"""DataSetIterator contract + implementations.
+
+Reference analog: org.nd4j.linalg.dataset.api.iterator.DataSetIterator
+(next/hasNext/reset/batch/totalExamples/setPreProcessor) and DL4J's
+AsyncDataSetIterator (prefetch thread feeding a queue). The async analog here
+double-buffers host->device transfer on a background thread so the TPU never
+waits on input — the DL4J prefetch idea with jax.device_put instead of
+workspace pinning.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterable+resettable; subclasses implement _produce()."""
+
+    def __init__(self, batch_size: int):
+        self.batch = batch_size
+        self.preprocessor = None
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for ds in self._produce():
+            if self.preprocessor is not None:
+                self.preprocessor.transform(ds)
+            yield ds
+
+    def _produce(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def set_preprocessor(self, pre):
+        self.preprocessor = pre
+        return self
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over pre-built DataSet batches (ListDataSetIterator)."""
+
+    def __init__(self, datasets: list[DataSet], batch_size: int = 0):
+        super().__init__(batch_size or (datasets[0].num_examples() if datasets else 0))
+        self.datasets = datasets
+
+    def _produce(self):
+        yield from self.datasets
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batch a (features, labels) array pair, optional shuffle each epoch."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = False):
+        super().__init__(batch_size)
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    def _produce(self):
+        n = self.features.shape[0]
+        idx = self._rng.permutation(n) if self.shuffle else np.arange(n)
+        for i in range(0, n, self.batch):
+            sl = idx[i : i + self.batch]
+            if self.drop_last and len(sl) < self.batch:
+                break
+            yield DataSet(self.features[sl], self.labels[sl])
+
+    def total_examples(self) -> int:
+        return int(self.features.shape[0])
+
+
+class AsyncPrefetchIterator(DataSetIterator):
+    """Wrap any iterator with a background prefetch thread (AsyncDataSetIterator).
+
+    queue_size=2 gives double buffering: batch N+1 is staged while the device
+    runs batch N.
+    """
+
+    def __init__(self, inner: DataSetIterator, queue_size: int = 2, device_put: bool = True):
+        super().__init__(getattr(inner, "batch", 0))
+        self.inner = inner
+        self.queue_size = queue_size
+        self.device_put = device_put
+
+    def _produce(self):
+        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        _END = object()
+
+        def worker():
+            try:
+                for ds in self.inner:
+                    if self.device_put:
+                        import jax
+
+                        ds = DataSet(
+                            jax.device_put(ds.features), jax.device_put(ds.labels),
+                            None if ds.features_mask is None else jax.device_put(ds.features_mask),
+                            None if ds.labels_mask is None else jax.device_put(ds.labels_mask),
+                        )
+                    q.put(ds)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item
+        t.join()
+
+    def reset(self):
+        self.inner.reset()

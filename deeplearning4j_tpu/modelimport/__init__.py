@@ -1,0 +1,9 @@
+"""Model import: Keras h5 and TF graphs.
+
+Reference analog: deeplearning4j-modelimport (org.deeplearning4j.nn.
+modelimport.keras.KerasModelImport) and org.nd4j.imports (TFGraphMapper).
+"""
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+__all__ = ["KerasModelImport"]

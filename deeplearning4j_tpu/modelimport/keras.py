@@ -1,0 +1,231 @@
+"""Keras h5 model import.
+
+Reference analog: deeplearning4j-modelimport :: org.deeplearning4j.nn.
+modelimport.keras.KerasModelImport (+ per-layer mappers in
+org.deeplearning4j.nn.modelimport.keras.layers.**). Reads the Keras-2 h5
+format (``model_config`` JSON attribute + ``model_weights`` group), maps each
+Keras layer config to the native layer catalog, and copies weights with the
+required gate/axis permutations (e.g. Keras LSTM gate order i,f,c,o ->
+our IFOG i,f,o,g).
+
+Sequential models -> MultiLayerNetwork; Functional models with linear
+topology -> MultiLayerNetwork, otherwise ComputationGraph [graph topology
+import: linear chains supported this round].
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalizationLayer, Convolution1DLayer, ConvolutionLayer,
+    DenseLayer, DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    GRULayer, LSTMLayer, OutputLayer, SimpleRnnLayer, SubsamplingLayer,
+    ZeroPadding2DLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+_KERAS_ACT = {
+    "linear": "identity", "relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh",
+    "softmax": "softmax", "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "hard_sigmoid": "hardsigmoid", "swish": "swish",
+    "gelu": "gelu",
+}
+
+
+def _pad(cfg):
+    return "same" if cfg.get("padding", "valid") == "same" else "valid"
+
+
+class KerasLayerMapper:
+    """Maps one Keras layer config dict -> (native layer or None, is_input)."""
+
+    def map(self, cls: str, cfg: dict) -> Optional[object]:
+        act = _KERAS_ACT.get(cfg.get("activation", "linear"), "identity")
+        if cls == "Dense":
+            return DenseLayer(n_out=cfg["units"], activation=act,
+                              has_bias=cfg.get("use_bias", True))
+        if cls == "Conv2D":
+            return ConvolutionLayer(
+                n_out=cfg["filters"], kernel=tuple(cfg["kernel_size"]),
+                strides=tuple(cfg.get("strides", (1, 1))), padding=_pad(cfg),
+                dilation=tuple(cfg.get("dilation_rate", (1, 1))), activation=act,
+                has_bias=cfg.get("use_bias", True))
+        if cls == "Conv1D":
+            return Convolution1DLayer(
+                n_out=cfg["filters"], kernel=cfg["kernel_size"][0],
+                strides=cfg.get("strides", [1])[0], padding=_pad(cfg), activation=act,
+                has_bias=cfg.get("use_bias", True))
+        if cls in ("MaxPooling2D", "AveragePooling2D"):
+            return SubsamplingLayer(
+                kernel=tuple(cfg["pool_size"]),
+                strides=tuple(cfg.get("strides") or cfg["pool_size"]),
+                padding=_pad(cfg),
+                pooling_type="max" if cls.startswith("Max") else "avg")
+        if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
+            return GlobalPoolingLayer(pooling_type="avg")
+        if cls in ("GlobalMaxPooling2D", "GlobalMaxPooling1D"):
+            return GlobalPoolingLayer(pooling_type="max")
+        if cls == "BatchNormalization":
+            return BatchNormalizationLayer(eps=cfg.get("epsilon", 1e-3),
+                                           decay=cfg.get("momentum", 0.99))
+        if cls == "Dropout":
+            return DropoutLayer(rate=cfg["rate"])
+        if cls == "Activation":
+            return ActivationLayer(activation=act)
+        if cls == "Flatten":
+            return None  # handled by automatic preprocessor insertion
+        if cls == "ZeroPadding2D":
+            p = cfg["padding"]
+            return ZeroPadding2DLayer(pad=tuple(tuple(q) for q in p))
+        if cls == "LSTM":
+            return LSTMLayer(n_out=cfg["units"])
+        if cls == "GRU":
+            return GRULayer(n_out=cfg["units"])
+        if cls == "SimpleRNN":
+            return SimpleRnnLayer(n_out=cfg["units"], activation=act)
+        if cls == "Embedding":
+            return EmbeddingSequenceLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"])
+        if cls in ("InputLayer",):
+            return None
+        raise ValueError(f"unsupported Keras layer type: {cls}")
+
+
+def _input_type_from_shape(shape) -> InputType:
+    """batch_input_shape (None, ...) -> InputType."""
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])  # NHWC
+    raise ValueError(f"cannot infer input type from shape {shape}")
+
+
+class KerasModelImport:
+    """KerasModelImport.importKerasSequentialModelAndWeights analog."""
+
+    @staticmethod
+    def import_model(h5_path: str) -> MultiLayerNetwork:
+        import h5py
+
+        with h5py.File(h5_path, "r") as f:
+            raw = f.attrs["model_config"]
+            cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
+            model = KerasModelImport._build(cfg)
+            KerasModelImport._load_weights(model, f, cfg)
+        return model
+
+    # ------------------------------------------------------------- topology
+    @staticmethod
+    def _build(cfg: dict) -> MultiLayerNetwork:
+        cls = cfg["class_name"]
+        layers_cfg = cfg["config"]["layers"]
+        if cls == "Functional":
+            # linear-chain functional models only (round 1)
+            pass
+        mapper = KerasLayerMapper()
+        built = []
+        itype = None
+        keras_names = []  # keras layer name per built layer (for weight loading)
+        for lc in layers_cfg:
+            kcls = lc["class_name"]
+            kcfg = lc["config"]
+            if itype is None:
+                shape = kcfg.get("batch_input_shape") or kcfg.get("batch_shape")
+                if shape:
+                    itype = _input_type_from_shape(shape)
+                if kcls == "InputLayer":
+                    continue
+            layer = mapper.map(kcls, kcfg)
+            if layer is None:
+                continue
+            built.append(layer)
+            keras_names.append(kcfg["name"])
+        if itype is None:
+            raise ValueError("Keras model has no input shape information")
+
+        # last Dense with softmax/sigmoid becomes an OutputLayer for training parity
+        if built and isinstance(built[-1], DenseLayer) and not isinstance(
+                built[-1], OutputLayer):
+            last = built[-1]
+            loss = "mcxent" if last.activation == "softmax" else (
+                "xent" if last.activation == "sigmoid" else "mse")
+            built[-1] = OutputLayer(n_out=last.n_out, activation=last.activation,
+                                    loss=loss, has_bias=last.has_bias)
+
+        b = NeuralNetConfiguration.builder().updater(Adam(lr=1e-3)).list()
+        for l in built:
+            b = b.layer(l)
+        conf = b.set_input_type(itype).build()
+        model = MultiLayerNetwork(conf).init()
+        model._keras_names = keras_names
+        return model
+
+    # -------------------------------------------------------------- weights
+    @staticmethod
+    def _load_weights(model: MultiLayerNetwork, f, cfg: dict):
+        import jax.numpy as jnp
+
+        wg = f["model_weights"]
+
+        def arrays_for(name):
+            if name not in wg:
+                return []
+            g = wg[name]
+            names = [n.decode() if isinstance(n, bytes) else n
+                     for n in g.attrs.get("weight_names", [])]
+            return [np.asarray(g[n]) for n in names]
+
+        for li, (layer, kname) in enumerate(zip(model.layers, model._keras_names)):
+            ws = arrays_for(kname)
+            if not ws:
+                continue
+            p = model.params[li]
+            if isinstance(layer, (DenseLayer,)) and "W" in p:
+                p["W"] = jnp.asarray(ws[0])
+                if layer.has_bias and len(ws) > 1:
+                    p["b"] = jnp.asarray(ws[1])
+            elif isinstance(layer, ConvolutionLayer):
+                p["W"] = jnp.asarray(ws[0])  # keras HWIO == ours
+                if layer.has_bias and len(ws) > 1:
+                    p["b"] = jnp.asarray(ws[1])
+            elif isinstance(layer, BatchNormalizationLayer):
+                gamma, beta, mean, var = ws
+                p["gamma"] = jnp.asarray(gamma)
+                p["beta"] = jnp.asarray(beta)
+                model.state[li]["mean"] = jnp.asarray(mean)
+                model.state[li]["var"] = jnp.asarray(var)
+            elif isinstance(layer, LSTMLayer):
+                kernel, rec, bias = ws
+                H = layer.n_out
+                # keras gates i,f,c,o -> ours i,f,o,g(c)
+                perm = np.concatenate([np.arange(0, 2 * H),          # i, f
+                                       np.arange(3 * H, 4 * H),      # o
+                                       np.arange(2 * H, 3 * H)])     # c -> g
+                p["W"] = jnp.asarray(kernel[:, perm])
+                p["RW"] = jnp.asarray(rec[:, perm])
+                p["b"] = jnp.asarray(bias[perm])
+            elif isinstance(layer, GRULayer):
+                kernel, rec, bias = ws
+                # keras gates z,r,h -> ours r,z,n
+                H = layer.n_out
+                perm = np.concatenate([np.arange(H, 2 * H), np.arange(0, H),
+                                       np.arange(2 * H, 3 * H)])
+                p["W"] = jnp.asarray(kernel[:, perm])
+                p["RW"] = jnp.asarray(rec[:, perm])
+                p["b"] = jnp.asarray(bias.reshape(-1, 3 * H).sum(0)[perm])
+            elif isinstance(layer, EmbeddingSequenceLayer):
+                p["W"] = jnp.asarray(ws[0])
+            elif isinstance(layer, SimpleRnnLayer):
+                kernel, rec, bias = ws
+                p["W"] = jnp.asarray(kernel)
+                p["RW"] = jnp.asarray(rec)
+                p["b"] = jnp.asarray(bias)

@@ -95,6 +95,23 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True, kw_only=True)
+class PositionalEmbeddingLayer(Layer):
+    """Adds learned positional embeddings to [B,T,F] — net-new (BERT-style)."""
+
+    max_len: int = 512
+    n_out: Optional[int] = None
+
+    def init(self, key, itype):
+        d = self.n_out or itype.shape[1]
+        return {"P": 0.02 * jax.random.normal(key, (self.max_len, d))}, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t = x.shape[1]
+        return x + params["P"][:t], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
 class TransformerEncoderLayer(Layer):
     """Pre-norm transformer encoder block — net-new (BERT/GPT building block).
 

@@ -1,0 +1,16 @@
+"""Parallelism over device meshes — XLA collectives replace the reference's
+entire distribution stack.
+
+Reference analog (SURVEY.md §2.4): ParallelWrapper (single-node multi-GPU
+threads + gradient sharing), Spark ParameterAveragingTrainingMaster,
+SharedTrainingMaster + VoidParameterServer over Aeron UDP, ParallelInference.
+TPU-native redesign: one SPMD program over a jax.sharding.Mesh; gradients
+all-reduce over ICI via compiler-emitted psum; multi-host runs the same code
+under jax.distributed. TP/PP/SP are net-new capabilities the reference lacks.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+__all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference"]

@@ -1,0 +1,79 @@
+"""Parallel inference — request batching over devices.
+
+Reference analog: org.deeplearning4j.parallelism.ParallelInference — an
+observable queue that coalesces single requests into batches and round-robins
+them over per-device model replicas (INPLACE / BATCHED modes).
+
+TPU-native: one jitted forward sharded over the mesh's data axis does the
+replica fan-out; the host-side piece that survives is the batching queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+
+class ParallelInference:
+    """Batched inference server around a model's output().
+
+    batch_limit: max requests coalesced into one device batch;
+    queue_timeout_s: max wait to fill a batch before running partial.
+    """
+
+    def __init__(self, model, mesh: Optional[DeviceMesh] = None,
+                 batch_limit: int = 32, queue_timeout_s: float = 0.005):
+        self.model = model
+        self.mesh = mesh
+        self.batch_limit = batch_limit
+        self.queue_timeout_s = queue_timeout_s
+        self._q: queue.Queue = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # --- synchronous one-shot API (ParallelInference.output) ---
+    def output(self, x):
+        if self.mesh is not None:
+            with self.mesh.mesh:
+                return self.model.output(x)
+        return self.model.output(x)
+
+    # --- async batched API ---
+    def start(self):
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._worker:
+            self._worker.join(timeout=5)
+
+    def submit(self, x) -> "queue.Queue":
+        """Submit one example [features...] -> a result queue of size 1."""
+        out: queue.Queue = queue.Queue(maxsize=1)
+        self._q.put((np.asarray(x), out))
+        return out
+
+    def _run(self):
+        while not self._stop.is_set():
+            batch = []
+            try:
+                batch.append(self._q.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            while len(batch) < self.batch_limit:
+                try:
+                    batch.append(self._q.get(timeout=self.queue_timeout_s))
+                except queue.Empty:
+                    break
+            xs = np.stack([b[0] for b in batch])
+            ys = np.asarray(self.output(xs))
+            for (x, out), y in zip(batch, ys):
+                out.put(y)

@@ -1,0 +1,119 @@
+"""Sequence / context parallelism — ring attention.
+
+Reference analog: NONE — the reference's only long-sequence mechanism is
+truncated BPTT on one device (MultiLayerConfiguration tBPTTLength; SURVEY.md
+§5 "Long-context"). This is net-new capability, designed TPU-first: the
+sequence axis is sharded over the mesh's "seq" axis; each device holds a
+query block and rotates K/V blocks around the ICI ring with ppermute while
+accumulating attention online (flash-attention-style running max/denominator),
+so peak memory is O(T/n) and the T^2 work is evenly spread.
+
+Also provides Ulysses-style head-scatter attention (all_to_all swapping the
+shard axis from sequence to heads), the bandwidth-cheaper alternative when
+n_heads >= n_devices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 moved shard_map to jax.shard_map
+    from jax import shard_map as _shard_map_mod
+
+    shard_map = _shard_map_mod
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _ring_attention_local(q, k, v, *, axis, causal, scale):
+    """Per-device body. q/k/v local blocks [B, H, Tq, D] / [B, H, Tk, D]."""
+    axis_size = lax.psum(1, axis)
+    my_idx = lax.axis_index(axis)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    neg = jnp.finfo(jnp.float32).min
+
+    q32 = q.astype(jnp.float32) * scale
+    m0 = jnp.full((B, H, Tq, 1), neg, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+
+    qpos = my_idx * Tq + jnp.arange(Tq)
+
+    def body(i, carry):
+        m, l, o, k, v = carry
+        src = (my_idx - i) % axis_size  # which global block we currently hold
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32, k.astype(jnp.float32))
+        if causal:
+            kpos = src * Tk + jnp.arange(Tk)
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask, logits, neg)
+        m_new = jnp.maximum(m, logits.max(-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(-1, keepdims=True)
+        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        return m_new, l, o, k, v
+
+    m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, k, v))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
+                   scale: float | None = None):
+    """Ring attention over a mesh axis.
+
+    q/k/v: [B, H, T, D] with T sharded over ``axis`` (logically; pass the
+    full array — shard_map splits it). Returns [B, H, T, D] sharded the same.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis=axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None),
+    )
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis, causal, scale):
+    """Ulysses: all_to_all turns seq-sharded [B,H,Tl,D] into head-sharded
+    [B,Hl,T,D], runs full-sequence attention locally, then swaps back."""
+    # gather sequence, scatter heads
+    q = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    k = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    v = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        T = logits.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    w = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(q.dtype)
+    # scatter sequence back, gather heads
+    return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
+                      scale: float | None = None):
+    """Ulysses-style sequence parallelism (head all-to-all). Requires
+    n_heads % axis_size == 0."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis=axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None),
+    )
+    return fn(q, k, v)

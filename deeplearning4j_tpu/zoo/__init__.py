@@ -1,0 +1,23 @@
+"""Model zoo.
+
+Reference analog: deeplearning4j-zoo :: org.deeplearning4j.zoo.ZooModel and
+org.deeplearning4j.zoo.model.{LeNet, AlexNet, SimpleCNN, VGG16, VGG19,
+ResNet50, SqueezeNet, Darknet19, TinyYOLO, UNet, Xception,
+TextGenerationLSTM, ...}. Each zoo entry builds a ready-to-train model from
+hyperparameters; pretrained-weight download is gated on network availability
+(no egress here), so ``init_pretrained`` loads from a local path instead.
+"""
+
+from deeplearning4j_tpu.zoo.base import ZooModel
+from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.alexnet import AlexNet
+from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
+from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
+from deeplearning4j_tpu.zoo.resnet import ResNet50
+from deeplearning4j_tpu.zoo.textgen import TextGenerationLSTM, BidirectionalGravesLSTMCharRnn
+from deeplearning4j_tpu.zoo.bert import Bert, BertBase
+
+__all__ = [
+    "ZooModel", "LeNet", "AlexNet", "SimpleCNN", "VGG16", "VGG19", "ResNet50",
+    "TextGenerationLSTM", "BidirectionalGravesLSTMCharRnn", "Bert", "BertBase",
+]

@@ -1,0 +1,37 @@
+"""ZooModel base.
+
+Reference analog: org.deeplearning4j.zoo.ZooModel — init() builds an
+untrained model; initPretrained() restores weights (from a local checkpoint
+path here, since there is no egress).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ZooModel:
+    seed: int = 123
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize the untrained model (ZooModel.init)."""
+        from deeplearning4j_tpu.nn.conf.builders import (
+            ComputationGraphConfiguration, MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        c = self.conf()
+        if isinstance(c, ComputationGraphConfiguration):
+            return ComputationGraph(c).init(self.seed)
+        return MultiLayerNetwork(c).init(self.seed)
+
+    def init_pretrained(self, checkpoint_path: str):
+        """ZooModel.initPretrained analog: restore weights from a local zip."""
+        from deeplearning4j_tpu.util.serialization import restore_model
+
+        return restore_model(checkpoint_path)

@@ -1,0 +1,75 @@
+"""BERT — BASELINE.json config #4.
+
+Reference analog: the reference reaches BERT only via SameDiff TF-import
+(nd4j samediff/bert fine-tune config, org.nd4j.imports). Here BERT-base is a
+first-class zoo model: embedding + learned positions + N pre/post-norm
+transformer encoder blocks + pooled classification head — all tracing to one
+XLA program. The TF-import path (modelimport) can load checkpoint weights
+into this topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    EmbeddingSequenceLayer, LastTimeStepLayer, LayerNormalizationLayer,
+    OutputLayer, TransformerEncoderLayer,
+)
+from deeplearning4j_tpu.nn.layers.attention import PositionalEmbeddingLayer
+from deeplearning4j_tpu.nn.layers.conv import GlobalPoolingLayer
+from deeplearning4j_tpu.optimize.schedules import WarmupCosineSchedule
+from deeplearning4j_tpu.optimize.updaters import AdamW
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+@dataclasses.dataclass
+class Bert(ZooModel):
+    """Configurable BERT encoder for sequence classification fine-tuning."""
+
+    vocab_size: int = 30522
+    max_len: int = 128
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 2
+    dropout: float = 0.1
+    lr: float = 2e-5
+    warmup: int = 1000
+    total_steps: int = 100000
+    dtype: str = "bf16"
+
+    def conf(self):
+        b = (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(AdamW(lr=WarmupCosineSchedule(peak_value=self.lr,
+                                                   warmup_steps=self.warmup,
+                                                   total_steps=self.total_steps)))
+            .data_type(self.dtype)
+            .gradient_clipping(1.0)
+            .list()
+            .layer(EmbeddingSequenceLayer(n_in=self.vocab_size, n_out=self.d_model))
+            .layer(PositionalEmbeddingLayer(max_len=self.max_len))
+            .layer(LayerNormalizationLayer())
+        )
+        for _ in range(self.n_layers):
+            b = b.layer(TransformerEncoderLayer(
+                d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+                dropout_rate=self.dropout))
+        return (
+            b.layer(LayerNormalizationLayer())
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.recurrent(self.vocab_size, self.max_len))
+            .build()
+        )
+
+
+@dataclasses.dataclass
+class BertBase(Bert):
+    """BERT-base hyperparameters (the samediff/bert fine-tune scale)."""

@@ -1,0 +1,78 @@
+"""Character-RNN LSTM models — BASELINE.json config #3.
+
+Reference analog: org.deeplearning4j.zoo.model.TextGenerationLSTM and the
+dl4j-examples GravesLSTMCharModellingExample (bidirectional Graves LSTM
+char-RNN). On GPU the reference leaned on CudnnLSTMHelper; our scan-based
+lstm_layer op (ops/recurrent.py) is the TPU equivalent, with the input
+projection batched onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    GravesBidirectionalLSTMLayer, GravesLSTMLayer, LSTMLayer, RnnOutputLayer,
+)
+from deeplearning4j_tpu.optimize.updaters import Adam, RMSProp
+from deeplearning4j_tpu.zoo.base import ZooModel
+
+
+@dataclasses.dataclass
+class TextGenerationLSTM(ZooModel):
+    """org.deeplearning4j.zoo.model.TextGenerationLSTM: LSTM(256)x2 + RnnOutput."""
+
+    vocab_size: int = 77
+    units: int = 256
+    timesteps: int = 64
+    lr: float = 1e-3
+    dtype: str = "float32"
+
+    def conf(self):
+        return (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(RMSProp(lr=self.lr))
+            .data_type(self.dtype)
+            .gradient_clipping(5.0)
+            .list()
+            .layer(LSTMLayer(n_out=self.units))
+            .layer(LSTMLayer(n_out=self.units))
+            .layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(self.vocab_size, self.timesteps))
+            .build()
+        )
+
+
+@dataclasses.dataclass
+class BidirectionalGravesLSTMCharRnn(ZooModel):
+    """The BASELINE config-#3 topology: bidirectional Graves (peephole) LSTM
+    stack + per-timestep softmax, one-hot char input."""
+
+    vocab_size: int = 77
+    units: int = 200
+    timesteps: int = 64
+    layers: int = 2
+    lr: float = 1e-3
+    dtype: str = "float32"
+
+    def conf(self):
+        b = (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(Adam(lr=self.lr))
+            .data_type(self.dtype)
+            .gradient_clipping(5.0)
+            .list()
+        )
+        for _ in range(self.layers):
+            b = b.layer(GravesBidirectionalLSTMLayer(n_out=self.units))
+        return (
+            b.layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(self.vocab_size, self.timesteps))
+            .build()
+        )

@@ -1,0 +1,108 @@
+"""Numeric-vs-analytic gradient checks.
+
+Reference analog: org.deeplearning4j.gradientcheck.GradientCheckTests /
+CNNGradientCheckTest / LSTMGradientCheckTests — the verification backbone.
+Run in float64 (JAX CPU x64) for tight tolerances, like the reference's
+fp64 checks.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import grad_check, grad_check_model
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer, ConvolutionLayer, DenseLayer, GravesLSTMLayer,
+    LSTMLayer, OutputLayer, RnnOutputLayer, SelfAttentionLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.optimize import Sgd
+
+
+def _check(conf_layers, itype, x, y, rtol=2e-2):
+    b = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(lr=0.1)).list())
+    for l in conf_layers:
+        b = b.layer(l)
+    conf = b.set_input_type(itype).build()
+    model = MultiLayerNetwork(conf).init()
+    res = grad_check_model(model, x, y, rtol=rtol, max_checks_per_arg=24)
+    assert res["ok"], f"gradcheck failed: max_rel={res['max_rel_error']}, " \
+                      f"first failures: {res['failures'][:3]}"
+
+
+class TestGradientChecks:
+    def test_dense_softmax(self, rng):
+        x = rng.normal(size=(8, 6)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        _check(
+            [DenseLayer(n_out=5, activation="tanh"),
+             OutputLayer(n_out=4, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(6), x, y,
+        )
+
+    def test_cnn(self, rng):
+        x = rng.normal(size=(4, 8, 8, 2)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        _check(
+            [ConvolutionLayer(n_out=4, kernel=(3, 3), activation="tanh"),
+             SubsamplingLayer(kernel=(2, 2), pooling_type="max"),
+             OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+            InputType.convolutional(8, 8, 2), x, y,
+        )
+
+    def test_lstm(self, rng):
+        x = rng.normal(size=(4, 6, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4 * 6)].reshape(4, 6, 3)
+        _check(
+            [LSTMLayer(n_out=7),
+             RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+            InputType.recurrent(5, 6), x, y,
+        )
+
+    def test_graves_lstm_peepholes(self, rng):
+        x = rng.normal(size=(3, 5, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3 * 5)].reshape(3, 5, 2)
+        _check(
+            [GravesLSTMLayer(n_out=6),
+             RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(4, 5), x, y,
+        )
+
+    def test_batchnorm(self, rng):
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        _check(
+            [DenseLayer(n_out=6, activation="identity"),
+             BatchNormalizationLayer(),
+             OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(5), x, y,
+        )
+
+    def test_attention(self, rng):
+        x = rng.normal(size=(3, 6, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3 * 6)].reshape(3, 6, 2)
+        _check(
+            [SelfAttentionLayer(n_out=8, n_heads=2),
+             RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(8, 6), x, y,
+        )
+
+    def test_op_level_losses(self, rng):
+        """OpValidation analog for raw loss ops."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.losses import get_loss
+
+        y = np.abs(rng.normal(size=(4, 3))).astype(np.float32)
+        p = np.abs(rng.normal(size=(4, 3))).astype(np.float32) + 0.1
+        for loss in ("mse", "l1", "xent"):
+            fn = get_loss(loss)
+            if loss == "xent":
+                yy = (y > y.mean()).astype(np.float32)
+                pp = 1.0 / (1.0 + np.exp(-p))
+                res = grad_check(lambda a: fn(jnp.asarray(yy), a).sum(),
+                                 jnp.asarray(pp), rtol=2e-2)
+            else:
+                res = grad_check(lambda a: fn(jnp.asarray(y), a).sum(),
+                                 jnp.asarray(p), rtol=2e-2)
+            assert res["ok"], f"{loss}: {res['failures'][:2]}"

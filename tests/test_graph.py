@@ -1,0 +1,89 @@
+"""ComputationGraph tests — DAG topologies, residual adds, multi-output.
+
+Reference analog: deeplearning4j-core ComputationGraph tests
+(TestComputationGraphNetwork).
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ComputationGraph, InputType, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import Adam
+
+
+def _residual_conf():
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(5)
+        .updater(Adam(lr=1e-2))
+        .graph_builder()
+        .add_inputs("in")
+        .set_input_types(**{"in": InputType.feed_forward(8)})
+        .add_layer("fc1", DenseLayer(n_out=8, activation="relu"), "in")
+        .add_layer("fc2", DenseLayer(n_out=8, activation="identity"), "fc1")
+        .add_vertex("res", ElementWiseVertex(op="add"), "fc2", "fc1")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "res")
+        .set_outputs("out")
+        .build()
+    )
+
+
+class TestComputationGraph:
+    def test_residual_forward_and_fit(self, rng):
+        model = ComputationGraph(_residual_conf()).init()
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        out = model.output(x)
+        assert out.shape == (16, 3)
+        first = model.fit_batch((x, y))
+        for _ in range(40):
+            last = model.fit_batch((x, y))
+        assert last < first
+
+    def test_merge_vertex(self, rng):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(5)
+            .updater(Adam(lr=1e-2))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .set_input_types(a=InputType.feed_forward(4), b=InputType.feed_forward(6))
+            .add_layer("fa", DenseLayer(n_out=5, activation="relu"), "a")
+            .add_layer("fb", DenseLayer(n_out=7, activation="relu"), "b")
+            .add_vertex("merge", MergeVertex(), "fa", "fb")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+                       "merge")
+            .set_outputs("out")
+            .build()
+        )
+        model = ComputationGraph(conf).init()
+        xa = rng.normal(size=(8, 4)).astype(np.float32)
+        xb = rng.normal(size=(8, 6)).astype(np.float32)
+        out = model.output([xa, xb])
+        assert out.shape == (8, 2)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        loss = model.fit_batch(([xa, xb], y))
+        assert np.isfinite(loss)
+
+    def test_json_roundtrip(self):
+        conf = _residual_conf()
+        s = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(s)
+        assert conf2.topological_order == conf.topological_order
+        m = ComputationGraph(conf2).init()
+        assert m.num_params() > 0
+
+    def test_save_load(self, rng, tmp_path):
+        model = ComputationGraph(_residual_conf()).init()
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        model.fit_batch((x, y))
+        p = str(tmp_path / "g.zip")
+        model.save(p)
+        loaded = ComputationGraph.load(p)
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(loaded.output(x)), rtol=1e-6)
