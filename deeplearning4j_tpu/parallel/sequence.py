@@ -29,6 +29,12 @@ try:  # jax >= 0.6 moved shard_map to jax.shard_map
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+if hasattr(lax, "pcast"):  # jax >= 0.9; pvary is deprecated
+    def _pvary(x, axes):
+        return lax.pcast(x, axes, to="varying")
+else:  # pragma: no cover
+    _pvary = lax.pvary
+
 
 def _ring_attention_local(q, k, v, *, axis, causal, scale):
     """Per-device body. q/k/v local blocks [B, H, Tq, D] / [B, H, Tk, D]."""
@@ -39,9 +45,12 @@ def _ring_attention_local(q, k, v, *, axis, causal, scale):
     neg = jnp.finfo(jnp.float32).min
 
     q32 = q.astype(jnp.float32) * scale
-    m0 = jnp.full((B, H, Tq, 1), neg, jnp.float32)
-    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
-    o0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    # The accumulators become device-varying inside the loop (they depend on
+    # my_idx via the causal mask and on the rotating K/V); mark them varying
+    # up front so the fori_loop carry types are stable.
+    m0 = _pvary(jnp.full((B, H, Tq, 1), neg, jnp.float32), (axis,))
+    l0 = _pvary(jnp.zeros((B, H, Tq, 1), jnp.float32), (axis,))
+    o0 = _pvary(jnp.zeros((B, H, Tq, D), jnp.float32), (axis,))
 
     qpos = my_idx * Tq + jnp.arange(Tq)
 

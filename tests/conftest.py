@@ -11,12 +11,20 @@ Must run before jax is imported anywhere, hence top of conftest.
 
 import os
 
-# Force CPU: the sandbox presets JAX_PLATFORMS=axon (real TPU tunnel); tests
-# must run on the virtual 8-device CPU mesh regardless.
+# Force CPU: the sandbox presets JAX_PLATFORMS=axon (real TPU tunnel) and its
+# sitecustomize additionally calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which overrides the env var. Tests must
+# run on the virtual 8-device CPU mesh regardless, so set both the env var
+# (for subprocesses) and the config (wins over sitecustomize).
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -69,10 +69,10 @@ class TestMnistPipeline:
         """The minimum end-to-end slice (SURVEY §7): LeNet on MNIST converging."""
         from deeplearning4j_tpu.datasets import MnistDataSetIterator
 
-        train = MnistDataSetIterator(batch_size=64, train=True, n_examples=512)
+        train = MnistDataSetIterator(batch_size=64, train=True, n_examples=1024)
         test = MnistDataSetIterator(batch_size=64, train=False, n_examples=256,
                                     shuffle=False)
         model = LeNet(lr=3e-3).init()
-        model.fit(train, epochs=3)
+        model.fit(train, epochs=4)
         ev = model.evaluate(test)
         assert ev.accuracy() > 0.85, f"LeNet failed to learn: acc={ev.accuracy()}"
