@@ -12,5 +12,8 @@ under jax.distributed. TP/PP/SP are net-new capabilities the reference lacks.
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.tensor_parallel import TensorParallel
+from deeplearning4j_tpu.parallel.pipeline import GPipe, pipeline_train_step, stack_stage_params
 
-__all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference"]
+__all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference", "TensorParallel",
+           "GPipe", "pipeline_train_step", "stack_stage_params"]

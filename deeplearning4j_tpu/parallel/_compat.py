@@ -1,0 +1,18 @@
+"""Version shims shared by the shard_map-based parallel modules."""
+
+from __future__ import annotations
+
+from jax import lax
+
+try:  # jax >= 0.6 moved shard_map to jax.shard_map
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+if hasattr(lax, "pcast"):  # jax >= 0.9; pvary is deprecated
+    def pvary(x, axes):
+        return lax.pcast(x, axes, to="varying")
+else:  # pragma: no cover
+    pvary = lax.pvary
+
+__all__ = ["shard_map", "pvary"]

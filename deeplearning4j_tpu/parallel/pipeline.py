@@ -1,0 +1,150 @@
+"""Pipeline parallelism — GPipe-style microbatched stage loop.
+
+Reference analog: NONE — the reference has no pipeline parallelism (SURVEY.md
+§2.4). Net-new, TPU-first design: the "pipe" mesh axis holds one stage per
+device; microbatch activations rotate stage-to-stage with ``lax.ppermute``
+over the ICI ring inside a ``lax.fori_loop``. The whole pipeline — all
+bubbles, sends, and stage compute — is a single differentiable SPMD program,
+so ``jax.grad`` of the pipelined forward IS pipelined backprop (ppermute's
+transpose is the reverse rotation); no hand-written 1F1B schedule is needed
+for correctness, and XLA overlaps the ppermute with stage compute.
+
+Constraints (documented, enforced): every stage must map activations of one
+fixed shape to the same shape (the classic homogeneous-block setting, e.g. a
+stack of transformer blocks); stage parameters are passed stacked on a
+leading ``n_stages`` axis and sharded over "pipe".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+from deeplearning4j_tpu.parallel._compat import pvary as _pvary, shard_map
+
+
+def stack_stage_params(stage_params_list):
+    """Stack per-stage param pytrees along a new leading axis (to be sharded
+    over "pipe"). All stages must share a param structure."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_params_list)
+
+
+def _pipeline_local(params, x, *, stage_fn, n_micro, axis):
+    """Per-device body under shard_map. params: leading dim 1 (this stage's
+    slice); x: the full batch (replicated over "pipe")."""
+    params = jax.tree_util.tree_map(lambda p: p[0], params)
+    n_stages = lax.psum(1, axis)
+    stage = lax.axis_index(axis)
+    micro = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+    mshape = micro.shape[1:]
+
+    carry0 = _pvary(jnp.zeros(mshape, x.dtype), (axis,))
+    outs0 = _pvary(jnp.zeros((n_micro,) + mshape, x.dtype), (axis,))
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def body(t, state):
+        carry, outs = state
+        # stage 0 ingests microbatch t (clipped; out-of-range iterations feed
+        # garbage that is never written to outs), others take the carry.
+        feed = lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        inp = jnp.where(stage == 0, feed, carry)
+        out = stage_fn(params, inp)
+        # last stage has finished microbatch t - (n_stages - 1) at step t
+        widx = t - (n_stages - 1)
+        write = jnp.logical_and(stage == n_stages - 1, widx >= 0)
+        prev = lax.dynamic_index_in_dim(
+            outs, jnp.clip(widx, 0, n_micro - 1), 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(write, out, prev), jnp.clip(widx, 0, n_micro - 1), 0)
+        carry = lax.ppermute(out, axis, perm)
+        return carry, outs
+
+    total = n_micro + n_stages - 1
+    _, outs = lax.fori_loop(0, total, body, (carry0, outs0))
+    # outs is only valid on the last stage; broadcast it to every pipe device
+    # (psum of a one-hot-masked tensor — GSPMD lowers this to a broadcast).
+    outs = lax.psum(jnp.where(stage == n_stages - 1, outs, 0), axis)
+    return outs.reshape(x.shape)
+
+
+class GPipe:
+    """Microbatched pipeline over the mesh "pipe" axis.
+
+    ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape``;
+    ``params`` stacked on a leading n_stages axis (``stack_stage_params``).
+
+        pipe = GPipe(stage_fn, mesh, n_microbatches=4)
+        y = pipe(stacked_params, x)            # pipelined forward
+        grads = jax.grad(loss_of(pipe))(...)   # pipelined backward for free
+    """
+
+    def __init__(self, stage_fn: Callable, mesh: DeviceMesh,
+                 n_microbatches: int = 4, axis: str = "pipe"):
+        self.stage_fn = stage_fn
+        self.mesh = mesh
+        self.n_micro = n_microbatches
+        self.axis = axis
+
+    def __call__(self, stacked_params, x):
+        n_stages = self.mesh.shape[self.axis]
+        lead = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        if lead != n_stages:
+            raise ValueError(f"params stacked for {lead} stages but mesh "
+                             f"'{self.axis}' axis has {n_stages}")
+        if x.shape[0] % self.n_micro:
+            raise ValueError(f"batch {x.shape[0]} not divisible by "
+                             f"{self.n_micro} microbatches")
+        fn = shard_map(
+            functools.partial(_pipeline_local, stage_fn=self.stage_fn,
+                              n_micro=self.n_micro, axis=self.axis),
+            mesh=self.mesh.mesh,
+            in_specs=(self._param_spec(stacked_params), P()),
+            out_specs=P(),
+        )
+        return fn(stacked_params, x)
+
+    def _param_spec(self, stacked_params):
+        return jax.tree_util.tree_map(
+            lambda p: P(*([self.axis] + [None] * (np.ndim(p) - 1))), stacked_params)
+
+    def sequential_reference(self, stacked_params, x):
+        """Unpipelined equivalent (for parity tests): apply stages in order."""
+        n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        for i in range(n_stages):
+            p = jax.tree_util.tree_map(lambda q: q[i], stacked_params)
+            x = self.stage_fn(p, x)
+        return x
+
+
+def pipeline_train_step(pipe: GPipe, loss_fn: Callable, optimizer,
+                        head_fn: Optional[Callable] = None):
+    """Build a jitted pipelined train step.
+
+    loss_fn(y_pred, y) -> scalar; head_fn(head_params, activations) -> y_pred
+    (e.g. the output projection, run replicated after the pipeline).
+    Returns step(params, opt_state, step_i, x, y) -> (params, opt_state, loss)
+    where params = {"stages": stacked, "head": head_params or {}}.
+    """
+
+    def loss(params, x, y):
+        h = pipe(params["stages"], x)
+        pred = head_fn(params.get("head", {}), h) if head_fn is not None else h
+        return loss_fn(pred, y)
+
+    @jax.jit
+    def step(params, opt_state, step_i, x, y):
+        lval, grads = jax.value_and_grad(loss)(params, x, y)
+        upd, opt_state = optimizer.update(grads, opt_state, params, step_i)
+        params = jax.tree_util.tree_map(lambda p, d: p - d, params, upd)
+        return params, opt_state, lval
+
+    return step

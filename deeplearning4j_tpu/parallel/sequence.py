@@ -22,18 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:  # jax >= 0.6 moved shard_map to jax.shard_map
-    from jax import shard_map as _shard_map_mod
-
-    shard_map = _shard_map_mod
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
-if hasattr(lax, "pcast"):  # jax >= 0.9; pvary is deprecated
-    def _pvary(x, axes):
-        return lax.pcast(x, axes, to="varying")
-else:  # pragma: no cover
-    _pvary = lax.pvary
+from deeplearning4j_tpu.parallel._compat import pvary as _pvary, shard_map
 
 
 def _ring_attention_local(q, k, v, *, axis, causal, scale):

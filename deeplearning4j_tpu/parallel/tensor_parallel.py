@@ -1,0 +1,155 @@
+"""Tensor (model) parallelism — megatron-style parameter sharding.
+
+Reference analog: NONE — the reference has no tensor parallelism (SURVEY.md
+§2.4: "Model / tensor parallel: absent"). This is net-new capability designed
+TPU-first: instead of hand-written split layers (Megatron's ColumnParallel /
+RowParallelLinear), we annotate each parameter with a PartitionSpec over the
+mesh's "model" axis and let XLA GSPMD partition the (unchanged) jitted train
+step, inserting the all-reduces/all-gathers over ICI itself.
+
+The rule table plays the role Megatron's layer classes play:
+    Dense / Output W [in, out]        -> P(None, "model")   (column parallel)
+    Dense b [out]                     -> P("model")
+    Conv kernel [kh, kw, cin, cout]   -> P(None, None, None, "model")
+    Embedding W [vocab, dim]          -> P(None, "model")
+    Attention qkv [in, h*d]           -> P(None, "model")    (head split)
+    Attention out-proj [h*d, out]     -> P("model", None)    (row parallel)
+    LSTM/RNN kernels [in, 4H]         -> P(None, "model")    (gate split)
+    Norm scales / scalars             -> replicated
+
+Consecutive column-parallel layers force a resharding between them; GSPMD
+inserts the minimal collective, which on TPU rides ICI. Correctness is
+independent of the rules (they are layout hints); tests check numerical
+equality with the unsharded model on a virtual mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+# (layer-class-name substring, param-name) -> spec builder taking ndim.
+# Checked in order; first match wins. None entries mean replicate.
+
+
+def _col(ndim):  # shard last dim over "model"
+    return P(*([None] * (ndim - 1) + ["model"]))
+
+
+def _row(ndim):  # shard first dim over "model"
+    return P(*(["model"] + [None] * (ndim - 1)))
+
+
+def default_rules(layer, name: str, ndim: int) -> P:
+    """Megatron-style default spec for one parameter."""
+    cls = type(layer).__name__
+    if ndim == 0:
+        return P()
+    if "Norm" in cls:
+        return P()
+    if name in ("Wo", "out_W", "proj_W"):  # attention output projection
+        return _row(ndim)
+    if name.startswith(("W", "kernel")) or name in ("gamma_w",):
+        return _col(ndim)
+    if name in ("b", "bias", "gb"):
+        return _col(ndim)  # bias lives with column split
+    if name.startswith("R"):  # recurrent kernels [H, 4H] — gate split
+        return _col(ndim)
+    return P()
+
+
+def _divisible(shape, spec, mesh: DeviceMesh) -> bool:
+    for dim, ax in zip(shape, spec):
+        if ax is not None and (dim % mesh.shape[ax] != 0):
+            return False
+    return True
+
+
+class TensorParallel:
+    """Places a model's parameters model-parallel on a mesh and runs its own
+    jitted train step under the mesh — GSPMD partitions everything else.
+
+    Usage::
+
+        mesh = DeviceMesh(data=2, model=4)
+        tp = TensorParallel(model, mesh)
+        tp.fit_batch((x, y))
+
+    ``rules(layer, param_name, ndim) -> PartitionSpec`` can override the
+    megatron-style defaults. Params whose dims don't divide the mesh axis are
+    silently replicated (same degrade-gracefully behavior as the reference's
+    platform-helper fallbacks).
+    """
+
+    def __init__(self, model, mesh: Optional[DeviceMesh] = None,
+                 rules: Optional[Callable] = None):
+        self.model = model
+        self.mesh = mesh or DeviceMesh(model=jax.device_count())
+        self.rules = rules or default_rules
+        self._placed = False
+
+    # ------------------------------------------------------------- placement
+    def param_specs(self):
+        """Per-layer pytrees of PartitionSpec, mirroring model.params."""
+        specs = []
+        for layer, p in zip(self.model.layers, self.model.params):
+            def spec_for(path, leaf, _layer=layer):
+                name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+                s = self.rules(_layer, name, np.ndim(leaf))
+                if not _divisible(np.shape(leaf), s, self.mesh):
+                    return P()
+                return s
+
+            specs.append(jax.tree_util.tree_map_with_path(spec_for, p))
+        return specs
+
+    def place(self):
+        specs = self.param_specs()
+        mesh = self.mesh.mesh
+        self.model.params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            self.model.params, specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        # state + optimizer state: replicate initially; after the first step
+        # they adopt GSPMD's propagated shardings (we reassign from outputs).
+        self.model.state = self.mesh.replicate(self.model.state)
+        self.model.opt_state = self.mesh.replicate(self.model.opt_state)
+        self._placed = True
+        return self
+
+    # ---------------------------------------------------------------- train
+    def fit_batch(self, ds) -> float:
+        if not self._placed:
+            self.place()
+        from deeplearning4j_tpu.nn.multilayer import _unpack
+
+        x, y, mask = _unpack(ds)
+        dp = self.mesh.shape["data"]
+        n = np.asarray(x).shape[0]
+        if n % max(dp, 1):
+            raise ValueError(f"batch {n} not divisible by data axis {dp}")
+        batch = self.mesh.shard_batch((x, y) if mask is None else (x, y, mask))
+        with self.mesh.mesh:
+            return self.model.fit_batch(batch)
+
+    def fit(self, data, epochs: int = 1):
+        for _ in range(epochs):
+            for ds in data:
+                self.fit_batch(ds)
+            if hasattr(data, "reset"):
+                data.reset()
+            self.model.epoch_count += 1
+        return self.model
+
+    def output(self, x):
+        if not self._placed:
+            self.place()
+        with self.mesh.mesh:
+            return self.model.output(jax.device_put(
+                np.asarray(x), self.mesh.batch_sharding(np.ndim(x))))
