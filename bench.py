@@ -16,16 +16,24 @@ import sys
 import time
 
 
-def _measure(step_fn, args, warmup=3, iters=10):
-    import jax
+def _measure(step_fn, args, loss_index, warmup=2, iters=30):
+    """Time ``iters`` data-dependent steps, forcing completion with a host
+    fetch of the final loss.
 
+    On tunneled PJRT backends (axon) ``block_until_ready`` can return before
+    remote execution finishes, which inflates throughput by orders of
+    magnitude; fetching a scalar to the host is the only reliable barrier.
+    Because every step consumes the previous step's outputs, one final fetch
+    transitively forces all ``iters`` executions; the (large, ~150ms) RPC
+    round-trip latency is amortized across the chain.
+    """
     for _ in range(warmup):
         args = step_fn(*args)
-    jax.block_until_ready(args)
+    float(args[loss_index].astype("float32").reshape(()))
     t0 = time.perf_counter()
     for _ in range(iters):
         args = step_fn(*args)
-    jax.block_until_ready(args)
+    float(args[loss_index].astype("float32").reshape(()))
     return (time.perf_counter() - t0) / iters
 
 
@@ -44,13 +52,14 @@ def bench_ours(batch):
     step = model._jit_cache.get("train") or model._make_train_step()
     key = jax.random.key(0)
 
-    def one(params, state, opt_state, i):
+    def one(params, state, opt_state, i, _prev_loss):
         p, s, o, loss = step(params, state, opt_state, i, {"input": x},
                              {"output": y}, key, None)
-        return p, s, o, i + 1
+        return p, s, o, i + 1, loss
 
-    args = (model.params, model.state, model.opt_state, jnp.asarray(0, jnp.int32))
-    dt = _measure(one, args)
+    args = (model.params, model.state, model.opt_state, jnp.asarray(0, jnp.int32),
+            jnp.asarray(0.0))
+    dt = _measure(one, args, loss_index=4)
     return batch / dt
 
 
@@ -106,7 +115,7 @@ def bench_flax_reference(batch):
     opt = tx.init(params)
 
     @jax.jit
-    def one(params, batch_stats, opt, i):
+    def one(params, batch_stats, opt, i, _prev_loss):
         def loss_fn(p):
             logits, upd = m.apply({"params": p, "batch_stats": batch_stats}, x,
                                   train=True, mutable=["batch_stats"])
@@ -116,9 +125,10 @@ def bench_flax_reference(batch):
 
         (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), bs, opt, i + 1
+        return optax.apply_updates(params, updates), bs, opt, i + 1, loss
 
-    dt = _measure(one, (params, batch_stats, opt, jnp.asarray(0)))
+    dt = _measure(one, (params, batch_stats, opt, jnp.asarray(0), jnp.asarray(0.0)),
+                  loss_index=4)
     return batch / dt
 
 

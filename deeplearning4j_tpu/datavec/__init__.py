@@ -1,0 +1,23 @@
+"""DataVec-equivalent ETL.
+
+Reference analog: the `datavec/` module family (SURVEY.md §1 L3) —
+RecordReader implementations (org.datavec.api.records.reader.impl.*),
+Schema + TransformProcess (org.datavec.api.transform.**) and the
+local executor. TPU-first: ETL stays host-side numpy (the device only sees
+ready batches), composing with the async device-prefetch iterators in
+deeplearning4j_tpu.datasets.
+"""
+
+from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
+from deeplearning4j_tpu.datavec.records import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    ImageRecordReader, LineRecordReader, RecordReader,
+)
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.iterators import RecordReaderDataSetIterator
+
+__all__ = [
+    "ColumnType", "Schema", "RecordReader", "CSVRecordReader",
+    "CSVSequenceRecordReader", "LineRecordReader", "CollectionRecordReader",
+    "ImageRecordReader", "TransformProcess", "RecordReaderDataSetIterator",
+]

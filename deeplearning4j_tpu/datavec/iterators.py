@@ -1,0 +1,68 @@
+"""RecordReader -> DataSet bridge.
+
+Reference analog: org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator
+(and SequenceRecordReaderDataSetIterator) — converts Writable records into
+(features, one-hot labels) minibatches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datavec.records import RecordReader
+
+
+class RecordReaderDataSetIterator:
+    """Batches records into DataSets.
+
+    ``label_index``: which record element is the label (appended last by
+    ImageRecordReader; a column index for CSV); ``num_classes`` one-hot
+    encodes integer labels; ``regression`` keeps labels as floats.
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        if not regression and num_classes is None:
+            # per-batch inference would give inconsistent one-hot widths
+            raise ValueError("classification requires num_classes (the "
+                             "reference's numPossibleLabels)")
+        self._it = None
+
+    def reset(self):
+        self.reader.reset()
+        self._it = None
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        feats, labels = [], []
+        while len(feats) < self.batch_size and self.reader.has_next():
+            r = self.reader.next_record()
+            li = self.label_index if self.label_index >= 0 else len(r) + self.label_index
+            label = r[li]
+            fvals = [v for i, v in enumerate(r) if i != li]
+            if len(fvals) == 1 and isinstance(fvals[0], np.ndarray):
+                feats.append(fvals[0])
+            else:
+                feats.append(np.asarray(fvals, np.float32))
+            labels.append(label)
+        if not feats:
+            raise StopIteration
+        x = np.stack(feats)
+        if self.regression:
+            y = np.asarray(labels, np.float32).reshape(len(labels), -1)
+        else:
+            y = np.eye(self.num_classes,
+                       dtype=np.float32)[np.asarray(labels, np.int64)]
+        return DataSet(x, y)

@@ -1,0 +1,190 @@
+"""TransformProcess — schema-aware record transformations.
+
+Reference analog: org.datavec.api.transform.TransformProcess (+ Builder) and
+the local executor (org.datavec.local.transforms.LocalTransformExecutor).
+Each step maps (schema, records) -> (schema, records); the Builder tracks the
+evolving schema exactly like the reference (getFinalSchema).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.schema import ColumnMeta, ColumnType, Schema
+
+
+@dataclasses.dataclass
+class _Step:
+    name: str
+    schema_fn: Callable[[Schema], Schema]
+    record_fn: Callable[[Schema, list], Optional[list]]  # None = filtered out
+
+
+class TransformProcess:
+    def __init__(self, initial: Schema, steps: List[_Step]):
+        self.initial_schema = initial
+        self.steps = steps
+
+    # -------------------------------------------------------------- executor
+    def final_schema(self) -> Schema:
+        s = self.initial_schema
+        for st in self.steps:
+            s = st.schema_fn(s)
+        return s
+
+    def execute(self, records: Sequence[list]) -> List[list]:
+        """LocalTransformExecutor.execute analog."""
+        out = [list(r) for r in records]
+        schema = self.initial_schema
+        for st in self.steps:
+            new = []
+            for r in out:
+                r2 = st.record_fn(schema, r)
+                if r2 is not None:
+                    new.append(r2)
+            out = new
+            schema = st.schema_fn(schema)
+        return out
+
+    # --------------------------------------------------------------- builder
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._initial = schema
+            self._steps: List[_Step] = []
+
+        # -- column removal/selection
+        def remove_columns(self, *names: str) -> "TransformProcess.Builder":
+            def schema_fn(s: Schema) -> Schema:
+                return Schema([c for c in s.columns if c.name not in names])
+
+            def record_fn(s: Schema, r: list):
+                drop = {s.index_of(n) for n in names}
+                return [v for i, v in enumerate(r) if i not in drop]
+
+            self._steps.append(_Step(f"remove{names}", schema_fn, record_fn))
+            return self
+
+        def remove_all_columns_except(self, *names: str) -> "TransformProcess.Builder":
+            def schema_fn(s: Schema) -> Schema:
+                return Schema([c for c in s.columns if c.name in names])
+
+            def record_fn(s: Schema, r: list):
+                keep = {s.index_of(n) for n in names}
+                return [v for i, v in enumerate(r) if i in keep]
+
+            self._steps.append(_Step(f"keep{names}", schema_fn, record_fn))
+            return self
+
+        # -- filters
+        def filter(self, predicate: Callable[[Schema, list], bool]
+                   ) -> "TransformProcess.Builder":
+            """Keep records where predicate(schema, record) is True
+            (FilterOp / ConditionFilter analog)."""
+
+            def record_fn(s: Schema, r: list):
+                return r if predicate(s, r) else None
+
+            self._steps.append(_Step("filter", lambda s: s, record_fn))
+            return self
+
+        # -- categorical
+        def categorical_to_integer(self, name: str) -> "TransformProcess.Builder":
+            def schema_fn(s: Schema) -> Schema:
+                cols = [ColumnMeta(c.name, ColumnType.INTEGER) if c.name == name
+                        else c for c in s.columns]
+                return Schema(cols)
+
+            def record_fn(s: Schema, r: list):
+                i = s.index_of(name)
+                cats = s.column(name).categories
+                r = list(r)
+                r[i] = cats.index(r[i])
+                return r
+
+            self._steps.append(_Step(f"cat2int({name})", schema_fn, record_fn))
+            return self
+
+        def categorical_to_one_hot(self, name: str) -> "TransformProcess.Builder":
+            def schema_fn(s: Schema) -> Schema:
+                cats = s.column(name).categories
+                cols = []
+                for c in s.columns:
+                    if c.name == name:
+                        cols.extend(ColumnMeta(f"{name}[{cat}]", ColumnType.INTEGER)
+                                    for cat in cats)
+                    else:
+                        cols.append(c)
+                return Schema(cols)
+
+            def record_fn(s: Schema, r: list):
+                i = s.index_of(name)
+                cats = s.column(name).categories
+                onehot = [1 if r[i] == cat else 0 for cat in cats]
+                return r[:i] + onehot + r[i + 1:]
+
+            self._steps.append(_Step(f"onehot({name})", schema_fn, record_fn))
+            return self
+
+        def string_to_categorical(self, name: str, *categories: str
+                                  ) -> "TransformProcess.Builder":
+            def schema_fn(s: Schema) -> Schema:
+                cols = [ColumnMeta(c.name, ColumnType.CATEGORICAL, list(categories))
+                        if c.name == name else c for c in s.columns]
+                return Schema(cols)
+
+            self._steps.append(_Step(f"str2cat({name})", schema_fn,
+                                     lambda s, r: r))
+            return self
+
+        # -- numeric math (DoubleMathOp analog)
+        def double_math_op(self, name: str, op: str, value: float
+                           ) -> "TransformProcess.Builder":
+            ops = {"add": lambda x: x + value, "subtract": lambda x: x - value,
+                   "multiply": lambda x: x * value, "divide": lambda x: x / value,
+                   "pow": lambda x: x ** value}
+            if op.lower() not in ops:
+                raise ValueError(f"unknown math op {op}")
+            f = ops[op.lower()]
+
+            def record_fn(s: Schema, r: list):
+                i = s.index_of(name)
+                r = list(r)
+                r[i] = f(float(r[i]))
+                return r
+
+            self._steps.append(_Step(f"math({name},{op})", lambda s: s, record_fn))
+            return self
+
+        def double_map(self, name: str, fn: Callable[[float], float]
+                       ) -> "TransformProcess.Builder":
+            def record_fn(s: Schema, r: list):
+                i = s.index_of(name)
+                r = list(r)
+                r[i] = fn(float(r[i]))
+                return r
+
+            self._steps.append(_Step(f"map({name})", lambda s: s, record_fn))
+            return self
+
+        # -- normalization over the dataset requires two passes; expose a
+        #    fit-style helper mirroring the reference's analysis + transform
+        def normalize_min_max(self, name: str, lo: float, hi: float
+                              ) -> "TransformProcess.Builder":
+            def record_fn(s: Schema, r: list):
+                i = s.index_of(name)
+                r = list(r)
+                span = (hi - lo) or 1.0
+                r[i] = (float(r[i]) - lo) / span
+                return r
+
+            self._steps.append(_Step(f"minmax({name})", lambda s: s, record_fn))
+            return self
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._initial, list(self._steps))
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)

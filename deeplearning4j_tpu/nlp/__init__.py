@@ -1,0 +1,21 @@
+"""NLP tooling.
+
+Reference analog: deeplearning4j-nlp-parent (SURVEY.md §2.3) —
+org.deeplearning4j.text.tokenization.** (tokenizers), org.deeplearning4j.
+models.word2vec.** (Word2Vec, VocabCache), models.glove.Glove,
+models.paragraphvectors.ParagraphVectors. TPU-first: corpus scanning and
+pair generation stay host-side; the embedding-update inner loop is a single
+jitted XLA program over batched (center, context, negatives) arrays instead
+of the reference's per-pair Hogwild threads.
+"""
+
+from deeplearning4j_tpu.nlp.tokenizers import (
+    DefaultTokenizerFactory, NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+__all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory", "VocabCache",
+           "Word2Vec", "Glove", "ParagraphVectors"]

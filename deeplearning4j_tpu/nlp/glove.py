@@ -1,0 +1,111 @@
+"""GloVe — global word-vector training on co-occurrence statistics.
+
+Reference analog: org.deeplearning4j.models.glove.Glove (+ builder). The
+reference streams co-occurrence pairs and applies per-pair AdaGrad updates;
+TPU-first the co-occurrence table is built host-side once, then the weighted
+least-squares objective is minimized with full-batch jitted AdaGrad steps
+over the (sparse, flattened) co-occurrence entries — one XLA program per
+epoch, MXU-friendly gathers/matmuls.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import Counter
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("lr",))
+def _glove_step(params, rows, cols, logx, weight, lr):
+    """AdaGrad step on J = Σ f(X_ij) (w_i·c_j + b_i + b̄_j − log X_ij)²."""
+
+    def loss_fn(p):
+        W, C, bw, bc = p["W"], p["C"], p["bw"], p["bc"]
+        pred = (jnp.einsum("bd,bd->b", W[rows], C[cols])
+                + bw[rows] + bc[cols])
+        return (weight * (pred - logx) ** 2).sum()
+
+    loss, grads = jax.value_and_grad(loss_fn)(
+        {k: params[k] for k in ("W", "C", "bw", "bc")})
+    new = dict(params)
+    for k in ("W", "C", "bw", "bc"):
+        g = grads[k]
+        acc = params["acc_" + k] + g * g
+        new[k] = params[k] - lr * g / jnp.sqrt(acc + 1e-8)
+        new["acc_" + k] = acc
+    return new, loss
+
+
+class Glove:
+    def __init__(self, vector_size: int = 100, window: int = 5,
+                 min_count: int = 1, epochs: int = 25, learning_rate: float = 0.05,
+                 x_max: float = 100.0, alpha: float = 0.75, seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.epochs = epochs
+        self.lr = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.seed = seed
+        self.vocab = VocabCache(min_count=min_count)
+        self.tokenizer = DefaultTokenizerFactory(CommonPreprocessor())
+        self.W: Optional[np.ndarray] = None
+
+    def _cooccurrences(self, encoded):
+        cooc: Counter = Counter()
+        for sent in encoded:
+            n = len(sent)
+            for i in range(n):
+                for j in range(max(0, i - self.window), min(n, i + self.window + 1)):
+                    if i == j:
+                        continue
+                    cooc[(int(sent[i]), int(sent[j]))] += 1.0 / abs(i - j)
+        return cooc
+
+    def fit(self, corpus) -> "Glove":
+        if isinstance(corpus, str):
+            corpus = corpus.splitlines()
+        sents = [self.tokenizer.tokenize(l) if isinstance(l, str) else l
+                 for l in corpus]
+        self.vocab.fit(sents)
+        V, D = len(self.vocab), self.vector_size
+        rng = np.random.default_rng(self.seed)
+        encoded = [self.vocab.encode(s) for s in sents]
+        cooc = self._cooccurrences(encoded)
+        if not cooc:
+            raise ValueError("no co-occurrences (corpus too small?)")
+        rows = np.asarray([k[0] for k in cooc], np.int32)
+        cols = np.asarray([k[1] for k in cooc], np.int32)
+        x = np.asarray(list(cooc.values()), np.float32)
+        logx = np.log(x)
+        weight = np.minimum(1.0, (x / self.x_max) ** self.alpha).astype(np.float32)
+
+        params = {
+            "W": jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D),
+            "C": jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D),
+            "bw": jnp.zeros(V), "bc": jnp.zeros(V),
+        }
+        for k in ("W", "C", "bw", "bc"):
+            params["acc_" + k] = jnp.zeros_like(params[k])
+        r, c, lx, wt = map(jnp.asarray, (rows, cols, logx, weight))
+        for _ in range(self.epochs):
+            params, _ = _glove_step(params, r, c, lx, wt, lr=self.lr)
+        self.W = np.asarray(params["W"]) + np.asarray(params["C"])  # GloVe sums
+        return self
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.W[i]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(va @ vb / ((np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12))

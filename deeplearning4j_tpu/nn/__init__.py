@@ -16,6 +16,7 @@ from deeplearning4j_tpu.nn.conf.builders import (
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.transferlearning import FineTuneConfiguration, TransferLearning
 
 __all__ = [
     "InputType",
@@ -24,4 +25,6 @@ __all__ = [
     "ComputationGraphConfiguration",
     "MultiLayerNetwork",
     "ComputationGraph",
+    "TransferLearning",
+    "FineTuneConfiguration",
 ]

@@ -146,11 +146,20 @@ class ComputationGraph:
     def _loss(self, params, state, inputs, labels: dict, rng, masks):
         acts, new_state, preouts = self._forward(params, state, inputs, True, rng,
                                                  masks=masks, want_preout=True)
+        from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
+
         loss = 0.0
         for name in self.conf.network_outputs:
             v = self.conf.vertices[name]
             if name in preouts and hasattr(v.layer, "score_from_preout"):
                 per = v.layer.score_from_preout(labels[name], preouts[name], None)
+                if isinstance(v.layer, CenterLossOutputLayer):
+                    feats = acts[self.conf.vertex_inputs[name][0]]
+                    cscore, cstate = v.layer.center_score_and_state(
+                        params.get(name, {}), state.get(name, {}), feats,
+                        labels[name])
+                    per = per + cscore
+                    new_state[name] = cstate
                 loss = loss + per.mean()
             else:
                 d = acts[name] - labels[name]

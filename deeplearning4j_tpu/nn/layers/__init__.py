@@ -10,7 +10,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     EmbeddingSequenceLayer, ElementWiseMultiplicationLayer,
 )
 from deeplearning4j_tpu.nn.layers.output import (
-    OutputLayer, RnnOutputLayer, LossLayer, CenterLossOutputLayer,
+    OutputLayer, RnnOutputLayer, LossLayer, CenterLossOutputLayer, CnnLossLayer,
 )
 from deeplearning4j_tpu.nn.layers.conv import (
     ConvolutionLayer, Convolution1DLayer, Convolution3DLayer,
@@ -27,6 +27,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     GravesBidirectionalLSTMLayer, LastTimeStepLayer, MaskZeroLayer,
     TimeDistributedLayer,
 )
+from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
 from deeplearning4j_tpu.nn.layers.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, TransformerEncoderLayer,
 )
@@ -36,6 +37,7 @@ __all__ = [
     "DenseLayer", "ActivationLayer", "DropoutLayer", "EmbeddingLayer",
     "EmbeddingSequenceLayer", "ElementWiseMultiplicationLayer",
     "OutputLayer", "RnnOutputLayer", "LossLayer", "CenterLossOutputLayer",
+    "CnnLossLayer",
     "ConvolutionLayer", "Convolution1DLayer", "Convolution3DLayer",
     "Deconvolution2DLayer", "SeparableConvolution2DLayer",
     "DepthwiseConvolution2DLayer", "SubsamplingLayer", "Subsampling1DLayer",
@@ -46,4 +48,5 @@ __all__ = [
     "BidirectionalLayer", "GravesBidirectionalLSTMLayer", "LastTimeStepLayer",
     "MaskZeroLayer", "TimeDistributedLayer",
     "SelfAttentionLayer", "LearnedSelfAttentionLayer", "TransformerEncoderLayer",
+    "Yolo2OutputLayer",
 ]

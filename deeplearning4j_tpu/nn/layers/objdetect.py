@@ -1,0 +1,181 @@
+"""Object-detection output layer (YOLOv2).
+
+Reference analog: org.deeplearning4j.nn.conf.layers.objdetect.Yolo2OutputLayer
+and org.deeplearning4j.nn.layers.objdetect.{Yolo2OutputLayer, YoloUtils,
+DetectedObject}. The reference computes the YOLOv2 loss in Java over NCHW
+activations; here it is a pure-jax function over NHWC activations that fuses
+into the model's single jitted train step.
+
+Layout (TPU-first, NHWC):
+    network output: [B, H, W, A*(5+C)]  (A = anchors, C = classes)
+    labels:         [B, H, W, 5+C] = (cx, cy, w, h, obj, one-hot classes)
+        cx, cy in [0,1] within-cell offsets; w, h in grid units; obj = 1 for
+        cells containing a ground-truth box center.
+
+(The reference's label format is a [mb, 4+C, H, W] NCHW tensor of corner
+coordinates; the cell-relative form used here carries the same information
+and avoids a host-side conversion pass.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+def _split_preds(preout, n_anchors, n_classes):
+    B, H, W, _ = preout.shape
+    p = preout.reshape(B, H, W, n_anchors, 5 + n_classes)
+    txy, twh, tconf, tcls = p[..., 0:2], p[..., 2:4], p[..., 4], p[..., 5:]
+    return txy, twh, tconf, tcls
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 loss head (org.deeplearning4j...objdetect.Yolo2OutputLayer).
+
+    ``anchors``: [(w, h), ...] bounding-box priors in grid units
+    (boundingBoxPriors). lambda_coord / lambda_no_obj follow the paper (and
+    the reference's defaults 5.0 / 0.5).
+    """
+
+    anchors: Sequence = ((1.0, 1.0),)
+    n_classes: int = 0
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def output_type(self, itype):
+        return itype
+
+    def preout(self, params, x):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x, state
+
+    # ------------------------------------------------------------------ loss
+    def score_from_preout(self, labels, preout, mask=None):
+        """Per-example YOLOv2 loss. labels [B,H,W,5+C], preout [B,H,W,A*(5+C)]."""
+        A = len(self.anchors)
+        C = self.n_classes
+        Bn, H, W, _ = preout.shape
+        pri = jnp.asarray(np.asarray(self.anchors, np.float32))  # [A,2]
+
+        txy, twh, tconf, tcls = _split_preds(preout.astype(jnp.float32), A, C)
+        pxy = jax.nn.sigmoid(txy)                       # within-cell offset
+        pwh = pri * jnp.exp(jnp.clip(twh, -8, 8))       # grid units
+        pconf = jax.nn.sigmoid(tconf)
+
+        gxy = labels[..., 0:2].astype(jnp.float32)      # [B,H,W,2]
+        gwh = labels[..., 2:4].astype(jnp.float32)
+        obj = labels[..., 4].astype(jnp.float32)        # [B,H,W]
+        gcls = labels[..., 5:].astype(jnp.float32)
+
+        # Anchor-matching IoU: predicted box vs the cell's GT box as if
+        # co-centered (the YOLOv2 anchor-responsibility criterion).
+        inter = (jnp.minimum(pwh[..., 0], gwh[..., None, 0]) *
+                 jnp.minimum(pwh[..., 1], gwh[..., None, 1]))
+        union = (pwh[..., 0] * pwh[..., 1] + (gwh[..., 0] * gwh[..., 1])[..., None]
+                 - inter + 1e-9)
+        iou = inter / union                              # [B,H,W,A]
+
+        # responsible anchor = argmax IoU in obj cells (straight-through one-hot)
+        resp = jax.lax.stop_gradient(
+            (iou >= iou.max(-1, keepdims=True)).astype(jnp.float32))
+        resp = resp / jnp.maximum(resp.sum(-1, keepdims=True), 1.0)
+        resp = resp * obj[..., None]                     # [B,H,W,A]
+
+        loss_xy = ((pxy - gxy[..., None, :]) ** 2).sum(-1)
+        loss_wh = ((jnp.sqrt(pwh) - jnp.sqrt(gwh[..., None, :] + 1e-9)) ** 2).sum(-1)
+        loss_obj = (pconf - jax.lax.stop_gradient(iou)) ** 2
+        loss_noobj = pconf ** 2
+        logp = jax.nn.log_softmax(tcls, axis=-1)
+        loss_cls = -(gcls[..., None, :] * logp).sum(-1)
+
+        per_cell = (self.lambda_coord * resp * (loss_xy + loss_wh)
+                    + resp * loss_obj
+                    + self.lambda_no_obj * (1.0 - resp) * loss_noobj
+                    + resp * loss_cls)
+        return per_cell.sum(axis=(1, 2, 3))              # [B]
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    """One decoded detection (org.deeplearning4j.nn.layers.objdetect.DetectedObject)."""
+
+    center_x: float  # grid units
+    center_y: float
+    width: float
+    height: float
+    confidence: float
+    class_index: int
+    class_probs: np.ndarray
+
+    def top_left(self):
+        return self.center_x - self.width / 2, self.center_y - self.height / 2
+
+    def bottom_right(self):
+        return self.center_x + self.width / 2, self.center_y + self.height / 2
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, preout, threshold: float = 0.5):
+    """YoloUtils.getPredictedObjects analog: decode + threshold. Host-side."""
+    A, C = len(layer.anchors), layer.n_classes
+    p = np.asarray(preout, np.float32)
+    Bn, H, W, _ = p.shape
+    p = p.reshape(Bn, H, W, A, 5 + C)
+    pri = np.asarray(layer.anchors, np.float32)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    out = []
+    for b in range(Bn):
+        dets = []
+        for i in range(H):
+            for j in range(W):
+                for a in range(A):
+                    conf = sig(p[b, i, j, a, 4])
+                    if conf < threshold:
+                        continue
+                    cx = j + sig(p[b, i, j, a, 0])
+                    cy = i + sig(p[b, i, j, a, 1])
+                    w = pri[a, 0] * np.exp(p[b, i, j, a, 2])
+                    h = pri[a, 1] * np.exp(p[b, i, j, a, 3])
+                    if C:
+                        logits = p[b, i, j, a, 5:]
+                        probs = np.exp(logits - logits.max())
+                        probs /= probs.sum()
+                        cls = int(probs.argmax())
+                    else:
+                        probs, cls = np.zeros(0, np.float32), 0
+                    dets.append(DetectedObject(float(cx), float(cy), float(w),
+                                               float(h), float(conf), cls, probs))
+        out.append(dets)
+    return out
+
+
+def non_max_suppression(dets, iou_threshold: float = 0.45):
+    """YoloUtils.nms analog over one image's DetectedObject list."""
+    dets = sorted(dets, key=lambda d: -d.confidence)
+    keep = []
+
+    def iou(a, b):
+        ax1, ay1 = a.top_left(); ax2, ay2 = a.bottom_right()
+        bx1, by1 = b.top_left(); bx2, by2 = b.bottom_right()
+        iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+        ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+        inter = iw * ih
+        ua = a.width * a.height + b.width * b.height - inter
+        return inter / ua if ua > 0 else 0.0
+
+    for d in dets:
+        if all(iou(d, k) <= iou_threshold or k.class_index != d.class_index
+               for k in keep):
+            keep.append(d)
+    return keep

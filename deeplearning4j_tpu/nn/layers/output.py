@@ -143,3 +143,32 @@ class CenterLossOutputLayer(OutputLayer):
         delta = (labels.T @ features - counts * centers + centers) / counts
         new_centers = centers + self.lambda_ * delta
         return score, {"centers": new_centers}
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class CnnLossLayer(Layer):
+    """Per-pixel loss over [B, H, W, C] activations
+    (org.deeplearning4j.nn.conf.layers.CnnLossLayer — used by UNet-style
+    segmentation heads). Loss computed per pixel, summed per example."""
+
+    loss: str = "xent"
+    activation: str = "sigmoid"
+
+    def preout(self, params, x):
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return resolve_activation(self.activation)(x), state
+
+    def score_from_preout(self, labels, preout, mask=None):
+        fn = get_loss(self.loss)
+        b = preout.shape[0]
+        p2 = preout.reshape(-1, preout.shape[-1])
+        l2 = labels.reshape(-1, labels.shape[-1])
+        m2 = mask.reshape(-1) if mask is not None else None
+        if _fused(self.activation, self.loss):
+            per = fn(l2, p2, m2, from_logits=True)
+        else:
+            per = fn(l2, resolve_activation(self.activation)(p2), m2)
+        return per.reshape(b, -1).sum(axis=1)

@@ -1,0 +1,134 @@
+"""DataVec ETL tests.
+
+Reference analog: datavec-api transform tests (TransformProcess schema
+evolution + record execution) and RecordReaderDataSetIterator tests.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    ImageRecordReader, LineRecordReader, RecordReaderDataSetIterator, Schema,
+    TransformProcess,
+)
+from deeplearning4j_tpu.datavec.schema import ColumnType
+
+
+class TestRecordReaders:
+    def test_csv(self, tmp_path):
+        f = tmp_path / "data.csv"
+        f.write_text("a,b,c\n1,2.5,x\n3,4.5,y\n")
+        rr = CSVRecordReader(f, skip_lines=1)
+        rows = list(rr)
+        assert rows == [[1, 2.5, "x"], [3, 4.5, "y"]]
+        # reset works
+        assert list(rr) == rows
+
+    def test_line(self, tmp_path):
+        f = tmp_path / "t.txt"
+        f.write_text("hello\nworld\n")
+        assert list(LineRecordReader(f)) == [["hello"], ["world"]]
+
+    def test_csv_sequence(self, tmp_path):
+        (tmp_path / "s1.csv").write_text("1,2\n3,4\n")
+        (tmp_path / "s2.csv").write_text("5,6\n")
+        rr = CSVSequenceRecordReader(tmp_path)
+        seqs = list(rr)
+        assert seqs == [[[1, 2], [3, 4]], [[5, 6]]]
+
+    def test_image_reader(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(d / f"{i}.npy", np.full((8, 6), i, np.float32))
+        rr = ImageRecordReader(tmp_path, height=4, width=4, channels=3)
+        recs = list(rr)
+        assert len(recs) == 6
+        img, label = recs[0]
+        assert img.shape == (4, 4, 3)
+        assert rr.labels == ["cat", "dog"]
+        assert {lbl for _, lbl in recs} == {0, 1}
+
+
+class TestTransformProcess:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_integer("id")
+                .add_column_double("value")
+                .add_column_categorical("state", "CA", "NY", "TX")
+                .build())
+
+    def test_schema_evolution(self):
+        tp = (TransformProcess.builder(self._schema())
+              .remove_columns("id")
+              .categorical_to_one_hot("state")
+              .build())
+        final = tp.final_schema()
+        assert final.names == ["value", "state[CA]", "state[NY]", "state[TX]"]
+
+    def test_execute(self):
+        tp = (TransformProcess.builder(self._schema())
+              .remove_columns("id")
+              .double_math_op("value", "multiply", 2.0)
+              .categorical_to_one_hot("state")
+              .build())
+        out = tp.execute([[7, 1.5, "NY"], [8, 3.0, "CA"]])
+        assert out == [[3.0, 0, 1, 0], [6.0, 1, 0, 0]]
+
+    def test_filter_and_cat_to_int(self):
+        tp = (TransformProcess.builder(self._schema())
+              .filter(lambda s, r: r[s.index_of("value")] > 1.0)
+              .categorical_to_integer("state")
+              .build())
+        out = tp.execute([[1, 0.5, "CA"], [2, 2.5, "TX"]])
+        assert out == [[2, 2.5, 2]]
+        assert tp.final_schema().column("state").type == ColumnType.INTEGER
+
+    def test_normalize_min_max(self):
+        tp = (TransformProcess.builder(self._schema())
+              .normalize_min_max("value", 0.0, 10.0)
+              .build())
+        out = tp.execute([[1, 5.0, "CA"]])
+        assert out[0][1] == pytest.approx(0.5)
+
+
+class TestRecordReaderDataSetIterator:
+    def test_csv_classification(self):
+        records = [[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 2], [0.7, 0.8, 0]]
+        it = RecordReaderDataSetIterator(CollectionRecordReader(records),
+                                         batch_size=3, label_index=-1,
+                                         num_classes=3)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].features.shape == (3, 2)
+        assert batches[0].labels.shape == (3, 3)
+        np.testing.assert_array_equal(batches[0].labels[1], [0, 1, 0])
+        # second epoch after implicit reset
+        assert len(list(it)) == 2
+
+    def test_image_to_dataset_and_train(self, tmp_path, rng):
+        for ci, cls in enumerate(("a", "b")):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(8):
+                np.save(d / f"{i}.npy",
+                        rng.normal(ci, 0.1, (6, 6, 3)).astype(np.float32))
+        rr = ImageRecordReader(tmp_path, height=6, width=6, channels=3)
+        it = RecordReaderDataSetIterator(rr, batch_size=4, num_classes=2)
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optimize import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(lr=0.5))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(6, 6, 3))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        model.fit(it, epochs=3)
+        ev = model.evaluate(it)
+        assert ev.accuracy() > 0.8

@@ -1,0 +1,113 @@
+"""NLP tests: tokenizers, vocab, Word2Vec/GloVe/ParagraphVectors.
+
+Reference analog: deeplearning4j-nlp tests (Word2VecTests sanity checks:
+vocab, similarity structure on a tiny synthetic corpus).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    DefaultTokenizerFactory, Glove, NGramTokenizerFactory, ParagraphVectors,
+    VocabCache, Word2Vec,
+)
+from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor
+
+# tiny synthetic corpus with two clear topics
+CORPUS = [
+    "the cat sat on the mat",
+    "the cat ate the fish",
+    "a cat and a dog played",
+    "the dog sat on the rug",
+    "the dog ate the bone",
+    "stocks rallied on the market today",
+    "the market closed higher on trading",
+    "investors bought stocks on the market",
+] * 8
+
+
+class TestTokenizers:
+    def test_default(self):
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        assert tf.tokenize("Hello, World!") == ["hello", "world"]
+
+    def test_ngram(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.tokenize("a b c")
+        assert "a" in toks and "a b" in toks and "b c" in toks
+
+
+class TestVocab:
+    def test_fit_and_prune(self):
+        v = VocabCache(min_count=2)
+        v.fit([["a", "a", "b"], ["a", "b", "c"]])
+        assert "a" in v and "b" in v and "c" not in v
+        assert v.word_frequency("a") == 3
+        # most frequent first
+        assert v.words[0] == "a"
+
+    def test_unigram_table(self):
+        v = VocabCache().fit([["x", "x", "x", "y"]])
+        p = v.unigram_table_probs()
+        assert p.shape == (2,) and abs(p.sum() - 1) < 1e-6
+        assert p[v.index_of("x")] > p[v.index_of("y")]
+
+
+class TestWord2Vec:
+    def test_skipgram_structure(self):
+        w2v = Word2Vec(vector_size=32, window=3, negative=4, epochs=15,
+                       learning_rate=0.01, batch_size=128, seed=7).fit(CORPUS)
+        assert w2v.get_word_vector("cat").shape == (32,)
+        # in-topic similarity beats cross-topic
+        assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "market")
+        near = w2v.words_nearest("stocks", top=4)
+        assert any(w in near for w in ("market", "investors", "trading", "rallied"))
+
+    def test_cbow_runs(self):
+        w2v = Word2Vec(vector_size=16, window=2, negative=3, epochs=3,
+                       cbow=True, seed=3).fit(CORPUS)
+        assert w2v.get_word_vector("dog") is not None
+        assert np.isfinite(w2v.W).all()
+
+    def test_save_load(self, tmp_path):
+        w2v = Word2Vec(vector_size=8, epochs=1, seed=1).fit(CORPUS[:8])
+        p = str(tmp_path / "w2v")
+        w2v.save(p)
+        loaded = Word2Vec.load(p)
+        np.testing.assert_array_equal(loaded.W, w2v.W)
+        assert loaded.vocab.index == w2v.vocab.index
+
+
+class TestGlove:
+    def test_structure(self):
+        gl = Glove(vector_size=24, window=4, epochs=300, learning_rate=0.05,
+                   x_max=10, seed=5).fit(CORPUS)
+        assert gl.get_word_vector("cat").shape == (24,)
+        # co-occurring words end up closer than never-co-occurring ones
+        assert gl.similarity("stocks", "market") > gl.similarity("stocks", "cat")
+        assert gl.similarity("dog", "cat") > gl.similarity("dog", "trading")
+
+
+class TestParagraphVectors:
+    def test_doc_similarity(self):
+        docs = (["the cat sat with the dog on the mat",
+                 "a dog and a cat played with the fish"] * 4
+                + ["stocks rallied as the market closed higher",
+                   "investors bought stocks in heavy market trading"] * 4)
+        labels = [f"animal_{i}" if i % 2 == 0 or i < 8 else f"fin_{i}"
+                  for i in range(len(docs))]
+        # simpler: first 8 animal docs, last 8 finance docs
+        labels = [f"animal_{i}" if i < 8 else f"fin_{i}" for i in range(len(docs))]
+        pv = ParagraphVectors(vector_size=24, window=3, negative=4, epochs=30,
+                              learning_rate=0.08, seed=11).fit(docs, labels)
+        assert pv.get_doc_vector("animal_0").shape == (24,)
+        sim_in = pv.similarity("animal_0", "animal_2")
+        sim_out = pv.similarity("animal_0", "fin_8")
+        assert sim_in > sim_out
+
+    def test_infer_vector(self):
+        docs = ["the cat sat on the mat"] * 4 + ["the market closed higher"] * 4
+        pv = ParagraphVectors(vector_size=16, window=2, epochs=10,
+                              seed=2).fit(docs)
+        v = pv.infer_vector("the cat sat")
+        assert v.shape == (16,) and np.isfinite(v).all()

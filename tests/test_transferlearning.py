@@ -1,0 +1,135 @@
+"""Transfer-learning tests.
+
+Reference analog: org.deeplearning4j.nn.transferlearning tests — freeze,
+head-swap, param-copy semantics.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ComputationGraph, FineTuneConfiguration, InputType, MultiLayerNetwork,
+    NeuralNetConfiguration, TransferLearning,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import Adam, Sgd
+
+
+def _mln(seed=7, n_out=4):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Sgd(lr=0.1))
+        .list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(DenseLayer(n_out=12, activation="relu"))
+        .layer(OutputLayer(n_out=n_out, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(8))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=7):
+    g = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Sgd(lr=0.1))
+        .graph_builder()
+        .add_inputs("in")
+        .set_input_types(**{"in": InputType.feed_forward(8)})
+    )
+    g.add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+    g.add_layer("d2", DenseLayer(n_out=12, activation="relu"), "d1")
+    g.add_layer("out", OutputLayer(n_out=4, activation="softmax", loss="mcxent"), "d2")
+    g.set_outputs("out")
+    return ComputationGraph(g.build()).init()
+
+
+class TestTransferLearningMLN:
+    def test_frozen_layers_unchanged(self, rng):
+        base = _mln()
+        new = (TransferLearning.Builder(base)
+               .set_feature_extractor(1)
+               .build())
+        w0_before = np.asarray(new.params[0]["W"]).copy()
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        for _ in range(4):
+            new.fit_batch((x, y))
+        np.testing.assert_array_equal(w0_before, np.asarray(new.params[0]["W"]))
+        # the (unfrozen) output layer did move
+        assert not np.allclose(np.asarray(base.params[2]["W"]),
+                               np.asarray(new.params[2]["W"]))
+
+    def test_params_copied(self):
+        base = _mln()
+        new = TransferLearning.Builder(base).set_feature_extractor(0).build()
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(base.params[i]["W"]),
+                                          np.asarray(new.params[i]["W"]))
+
+    def test_head_swap_nout_replace(self, rng):
+        base = _mln()
+        new = (TransferLearning.Builder(base)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Adam(lr=1e-3)))
+               .set_feature_extractor(1)
+               .n_out_replace(2, 10)
+               .build())
+        assert new.layers[2].n_out == 10
+        out = new.output(rng.normal(size=(5, 8)).astype(np.float32))
+        assert out.shape == (5, 10)
+        # hidden layers copied, head reinitialized
+        np.testing.assert_array_equal(np.asarray(base.params[1]["W"]),
+                                      np.asarray(new.params[1]["W"]))
+        assert np.asarray(new.params[2]["W"]).shape == (12, 10)
+
+    def test_remove_and_add_layers(self, rng):
+        base = _mln()
+        new = (TransferLearning.Builder(base)
+               .remove_output_layer()
+               .add_layer(DenseLayer(n_out=6, activation="tanh"))
+               .add_layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        assert len(new.layers) == 4
+        out = new.output(rng.normal(size=(3, 8)).astype(np.float32))
+        assert out.shape == (3, 2)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        l0 = new.fit_batch((x, y))
+        for _ in range(30):
+            l = new.fit_batch((x, y))
+        assert l < l0
+
+
+class TestTransferLearningGraph:
+    def test_freeze_upstream(self, rng):
+        base = _graph()
+        new = (TransferLearning.GraphBuilder(base)
+               .set_feature_extractor("d2")
+               .build())
+        w1 = np.asarray(new.params["d1"]["W"]).copy()
+        w2 = np.asarray(new.params["d2"]["W"]).copy()
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        for _ in range(4):
+            new.fit_batch(({"in": x}, {"out": y}))
+        np.testing.assert_array_equal(w1, np.asarray(new.params["d1"]["W"]))
+        np.testing.assert_array_equal(w2, np.asarray(new.params["d2"]["W"]))
+        assert not np.allclose(np.asarray(base.params["out"]["W"]),
+                               np.asarray(new.params["out"]["W"]))
+
+    def test_head_swap(self, rng):
+        base = _graph()
+        new = (TransferLearning.GraphBuilder(base)
+               .set_feature_extractor("d2")
+               .remove_vertex_and_connections("out")
+               .add_layer("newout",
+                          OutputLayer(n_out=7, activation="softmax", loss="mcxent"),
+                          "d2")
+               .set_outputs("newout")
+               .build())
+        out = new.output(rng.normal(size=(5, 8)).astype(np.float32))
+        out = out if not isinstance(out, (list, tuple)) else out[0]
+        assert np.asarray(out).shape == (5, 7)
+        np.testing.assert_array_equal(np.asarray(base.params["d2"]["W"]),
+                                      np.asarray(new.params["d2"]["W"]))

@@ -76,3 +76,111 @@ class TestMnistPipeline:
         model.fit(train, epochs=4)
         ev = model.evaluate(test)
         assert ev.accuracy() > 0.85, f"LeNet failed to learn: acc={ev.accuracy()}"
+
+
+class TestZooDetectionAndSegmentation:
+    def test_darknet19_tiny(self, rng):
+        from deeplearning4j_tpu.zoo import Darknet19
+
+        model = Darknet19(height=64, width=64, num_classes=8, dtype="float32").init()
+        x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+        out = model.output(x)
+        assert np.asarray(out).shape == (2, 8)
+        y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, 2)]
+        assert np.isfinite(model.fit_batch(({"input": x}, {"output": y})))
+
+    def test_tinyyolo(self, rng):
+        from deeplearning4j_tpu.zoo import TinyYOLO
+
+        model = TinyYOLO(height=64, width=64, n_classes=3, dtype="float32").init()
+        x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+        out = np.asarray(model.output(x))
+        # 64 / 2^5 = 2x2 grid, 5 anchors * (5 + 3) = 40 channels
+        assert out.shape == (2, 2, 2, 40)
+        labels = np.zeros((2, 2, 2, 8), np.float32)
+        labels[:, 0, 1, :] = [0.5, 0.5, 1.0, 1.5, 1.0, 0, 1, 0]
+        loss = model.fit_batch(({"input": x}, {"output": labels}))
+        assert np.isfinite(loss)
+
+    def test_yolo2_decode_nms(self, rng):
+        from deeplearning4j_tpu.nn.layers.objdetect import (
+            Yolo2OutputLayer, get_predicted_objects, non_max_suppression,
+        )
+
+        layer = Yolo2OutputLayer(anchors=((1.0, 1.0), (2.0, 2.0)), n_classes=2)
+        preout = rng.normal(size=(1, 4, 4, 2 * 7)).astype(np.float32)
+        preout = preout.reshape(1, 4, 4, 2, 7)
+        preout[..., 4] = -10.0  # low conf everywhere
+        preout[0, 1, 2, 0, 4] = 6.0  # one confident box
+        preout[0, 1, 2, 1, 4] = 5.0  # overlapping second anchor, same class
+        preout[0, 1, 2, :, 5] = 4.0
+        preout = preout.reshape(1, 4, 4, 14)
+        dets = get_predicted_objects(layer, preout, threshold=0.5)[0]
+        assert len(dets) == 2
+        kept = non_max_suppression(dets, iou_threshold=0.4)
+        assert len(kept) >= 1
+        assert kept[0].confidence > 0.99
+
+    def test_yolo2_model_loss_decreases(self, rng):
+        from deeplearning4j_tpu.zoo import YOLO2
+
+        model = YOLO2(height=32, width=32, n_classes=2, dtype="float32").init()
+        x = rng.normal(size=(1, 32, 32, 3)).astype(np.float32)
+        labels = np.zeros((1, 1, 1, 7), np.float32)
+        labels[0, 0, 0, :] = [0.3, 0.6, 1.0, 1.0, 1.0, 1, 0]
+        l0 = model.fit_batch(({"input": x}, {"output": labels}))
+        losses = [model.fit_batch(({"input": x}, {"output": labels}))
+                  for _ in range(25)]
+        assert np.isfinite(losses[-1])
+        assert np.mean(losses[-5:]) < l0, (l0, losses)
+
+    def test_unet_tiny(self, rng):
+        from deeplearning4j_tpu.zoo import UNet
+
+        model = UNet(height=32, width=32, base_filters=8, depth=2,
+                     dtype="float32").init()
+        x = rng.normal(size=(1, 32, 32, 3)).astype(np.float32)
+        out = np.asarray(model.output(x))
+        assert out.shape == (1, 32, 32, 1)
+        assert out.min() >= 0.0 and out.max() <= 1.0  # sigmoid map
+        y = (rng.random((1, 32, 32, 1)) > 0.5).astype(np.float32)
+        assert np.isfinite(model.fit_batch(({"input": x}, {"output": y})))
+
+
+class TestZooClassifiers:
+    def test_squeezenet_tiny(self, rng):
+        from deeplearning4j_tpu.zoo import SqueezeNet
+
+        model = SqueezeNet(height=48, width=48, num_classes=5, dtype="float32").init()
+        x = rng.normal(size=(2, 48, 48, 3)).astype(np.float32)
+        assert np.asarray(model.output(x)).shape == (2, 5)
+
+    def test_xception_tiny(self, rng):
+        from deeplearning4j_tpu.zoo import Xception
+
+        model = Xception(height=64, width=64, num_classes=4, middle_blocks=2,
+                         dtype="float32").init()
+        x = rng.normal(size=(1, 64, 64, 3)).astype(np.float32)
+        assert np.asarray(model.output(x)).shape == (1, 4)
+
+    def test_inception_resnet_v1_tiny(self, rng):
+        from deeplearning4j_tpu.zoo import InceptionResNetV1
+
+        model = InceptionResNetV1(height=64, width=64, num_classes=6,
+                                  embedding_size=16, blocks_a=1, blocks_b=1,
+                                  blocks_c=1, dtype="float32", lr=0.01).init()
+        x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+        assert np.asarray(model.output(x)).shape == (2, 6)
+        y = np.eye(6, dtype=np.float32)[rng.integers(0, 6, 2)]
+        l = model.fit_batch(({"input": x}, {"output": y}))
+        assert np.isfinite(l)
+        # center-loss state updated
+        assert "output" in model.state and "centers" in model.state["output"]
+
+    def test_nasnet_tiny(self, rng):
+        from deeplearning4j_tpu.zoo import NASNet
+
+        model = NASNet(height=32, width=32, num_classes=3, n_cells=1,
+                       penultimate_filters=96, dtype="float32").init()
+        x = rng.normal(size=(1, 32, 32, 3)).astype(np.float32)
+        assert np.asarray(model.output(x)).shape == (1, 3)
