@@ -1,0 +1,22 @@
+"""Hyperparameter optimization (Arbiter).
+
+Reference analog: the `arbiter/` module — org.deeplearning4j.arbiter.
+optimize.api.ParameterSpace, CandidateGenerator (RandomSearchGenerator,
+GridSearchCandidateGenerator), OptimizationRunner with score functions and
+termination conditions (SURVEY.md §2.3 "Tooling" / §7 step 8).
+"""
+
+from deeplearning4j_tpu.arbiter.spaces import (
+    ContinuousParameterSpace, DiscreteParameterSpace, IntegerParameterSpace,
+)
+from deeplearning4j_tpu.arbiter.runner import (
+    GridSearchGenerator, MaxCandidatesCondition, MaxTimeCondition,
+    OptimizationResult, OptimizationRunner, RandomSearchGenerator,
+)
+
+__all__ = [
+    "ContinuousParameterSpace", "DiscreteParameterSpace",
+    "IntegerParameterSpace", "RandomSearchGenerator", "GridSearchGenerator",
+    "OptimizationRunner", "OptimizationResult", "MaxCandidatesCondition",
+    "MaxTimeCondition",
+]

@@ -35,11 +35,9 @@ class RecordReaderDataSetIterator:
             # per-batch inference would give inconsistent one-hot widths
             raise ValueError("classification requires num_classes (the "
                              "reference's numPossibleLabels)")
-        self._it = None
 
     def reset(self):
         self.reader.reset()
-        self._it = None
 
     def __iter__(self):
         self.reset()

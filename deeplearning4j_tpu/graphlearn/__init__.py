@@ -1,0 +1,12 @@
+"""Graph (network) representation learning.
+
+Reference analog: deeplearning4j-graph — org.deeplearning4j.graph.models.
+deepwalk.DeepWalk, org.deeplearning4j.graph.graph.Graph, random-walk
+iterators. ("graphlearn" to avoid clashing with nn.graph, the
+ComputationGraph module.)
+"""
+
+from deeplearning4j_tpu.graphlearn.graph import Graph
+from deeplearning4j_tpu.graphlearn.deepwalk import DeepWalk
+
+__all__ = ["Graph", "DeepWalk"]

@@ -1,0 +1,55 @@
+"""DeepWalk — node embeddings from truncated random walks.
+
+Reference analog: org.deeplearning4j.graph.models.deepwalk.DeepWalk —
+random walks fed into skip-gram (the reference uses hierarchical softmax;
+here negative sampling, reusing the Word2Vec jitted step — the TPU-first
+batched variant of the same objective).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graphlearn.graph import Graph
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class DeepWalk:
+    def __init__(self, vector_size: int = 64, window: int = 5,
+                 walk_length: int = 20, walks_per_vertex: int = 10,
+                 negative: int = 5, epochs: int = 3,
+                 learning_rate: float = 0.01, seed: int = 42):
+        self.vector_size = vector_size
+        self.window = window
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.negative = negative
+        self.epochs = epochs
+        self.lr = learning_rate
+        self.seed = seed
+        self._w2v: Optional[Word2Vec] = None
+        self.n_vertices = 0
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        walks = graph.random_walks(self.walk_length, self.walks_per_vertex,
+                                   seed=self.seed)
+        sentences = [[str(v) for v in walk] for walk in walks]
+        self._w2v = Word2Vec(vector_size=self.vector_size, window=self.window,
+                             negative=self.negative, epochs=self.epochs,
+                             learning_rate=self.lr, batch_size=256,
+                             seed=self.seed)
+        # walks are already token lists; Word2Vec passes lists through untokenized
+        self._w2v.fit(sentences)
+        self.n_vertices = graph.n
+        return self
+
+    def get_vertex_vector(self, v: int) -> Optional[np.ndarray]:
+        return self._w2v.get_word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._w2v.similarity(str(a), str(b))
+
+    def vertices_nearest(self, v: int, top: int = 10):
+        return [int(w) for w in self._w2v.words_nearest(str(v), top)]

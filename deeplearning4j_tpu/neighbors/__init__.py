@@ -1,0 +1,15 @@
+"""Nearest-neighbor search.
+
+Reference analog: deeplearning4j-nearestneighbors-parent —
+org.deeplearning4j.clustering.vptree.VPTree, org.deeplearning4j.clustering.
+kdtree.KDTree, and the brute-force path used by the k-NN server. TPU-first
+addition: a jitted brute-force search (one [Q, N] distance matmul on the
+MXU) which on accelerators beats tree traversal for all but huge N — trees
+remain for host-side/streaming use, matching the reference's API.
+"""
+
+from deeplearning4j_tpu.neighbors.vptree import VPTree
+from deeplearning4j_tpu.neighbors.kdtree import KDTree
+from deeplearning4j_tpu.neighbors.knn import knn_search
+
+__all__ = ["VPTree", "KDTree", "knn_search"]

@@ -1,0 +1,44 @@
+"""Brute-force k-NN on device.
+
+Reference analog: the nearest-neighbors server's exhaustive path
+(deeplearning4j-nearestneighbors-server). TPU-first: one jitted
+[Q, D] x [D, N] distance computation + top-k — the MXU makes exhaustive
+search the fast path for N into the millions, replacing tree traversal.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _knn(points, queries, k, metric):
+    if metric == "cosine":
+        p = points / jnp.maximum(jnp.linalg.norm(points, axis=1, keepdims=True), 1e-12)
+        q = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+        d = 1.0 - q @ p.T
+    elif metric == "euclidean":
+        # ||q - p||^2 = ||q||^2 - 2 q·p + ||p||^2 (one matmul)
+        qq = (queries * queries).sum(1, keepdims=True)
+        pp = (points * points).sum(1)
+        d = jnp.sqrt(jnp.maximum(qq - 2.0 * queries @ points.T + pp, 0.0))
+    elif metric == "manhattan":
+        d = jnp.abs(queries[:, None, :] - points[None, :, :]).sum(-1)
+    else:
+        raise ValueError(f"unknown metric {metric}")
+    neg_d, idx = jax.lax.top_k(-d, k)
+    return idx, -neg_d
+
+
+def knn_search(points, queries, k: int = 1, metric: str = "euclidean"):
+    """Returns (indices [Q, k], distances [Q, k]), nearest first."""
+    points = jnp.asarray(np.asarray(points, np.float32))
+    queries = jnp.asarray(np.asarray(queries, np.float32))
+    if queries.ndim == 1:
+        queries = queries[None]
+    idx, d = _knn(points, queries, k, metric)
+    return np.asarray(idx), np.asarray(d)

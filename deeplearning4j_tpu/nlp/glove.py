@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
-from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.vocab import VocabCache, cosine_similarity
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("lr",))
@@ -105,7 +105,4 @@ class Glove:
         return None if i < 0 else self.W[i]
 
     def similarity(self, a: str, b: str) -> float:
-        va, vb = self.get_word_vector(a), self.get_word_vector(b)
-        if va is None or vb is None:
-            return float("nan")
-        return float(va @ vb / ((np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12))
+        return cosine_similarity(self.get_word_vector(a), self.get_word_vector(b))

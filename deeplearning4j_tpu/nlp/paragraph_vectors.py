@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
-from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.vocab import NegativeSampler, VocabCache, cosine_similarity
 from deeplearning4j_tpu.nlp.word2vec import cbow_windows
 
 
@@ -84,18 +84,21 @@ class ParagraphVectors:
         self.vocab.fit(sents)
         V, D, N = len(self.vocab), self.vector_size, len(documents)
         encoded = [self.vocab.encode(s) for s in sents]
-        probs = self.vocab.unigram_table_probs()
+        sampler = NegativeSampler(self.vocab.unigram_table_probs())
 
         Dv = jnp.asarray((rng.random((N, D), np.float32) - 0.5) / D)
         W = jnp.asarray((rng.random((V, D), np.float32) - 0.5) / D)
         C = jnp.zeros((V, D), jnp.float32)
         docs, ctxs, centers = self._examples(encoded)
+        if len(docs) == 0:
+            raise ValueError("no context windows — every document is empty "
+                             "or a single token after tokenization")
         for _ in range(self.epochs):
             order = rng.permutation(len(docs))
             B = min(self.batch_size, len(docs))
             for s in range(0, (len(docs) // B) * B, B):
                 sl = order[s:s + B]
-                negs = rng.choice(V, size=(B, self.negative), p=probs).astype(np.int32)
+                negs = sampler.sample(rng, (B, self.negative))
                 Dv, W, C, _ = _pvdm_step(Dv, W, C, jnp.asarray(docs[sl]),
                                          jnp.asarray(ctxs[sl]),
                                          jnp.asarray(centers[sl]),
@@ -120,13 +123,14 @@ class ParagraphVectors:
             return np.zeros(D, np.float32)
         encoded = [toks]
         docs, ctxs, centers = self._examples(encoded)
-        probs = self.vocab.unigram_table_probs()
+        if len(docs) == 0:
+            return np.zeros(D, np.float32)
+        sampler = NegativeSampler(self.vocab.unigram_table_probs())
         Dv = jnp.asarray((rng.random((1, D), np.float32) - 0.5) / D)
         W, C = jnp.asarray(self.W), jnp.asarray(self.C)
         B = len(docs)
         for _ in range(steps):
-            negs = rng.choice(len(self.vocab), size=(B, self.negative),
-                              p=probs).astype(np.int32)
+            negs = sampler.sample(rng, (B, self.negative))
             Dv, W, C, _ = _pvdm_step(Dv, W, C, jnp.asarray(docs),
                                      jnp.asarray(ctxs), jnp.asarray(centers),
                                      jnp.asarray(negs), lr=self.lr,
@@ -134,7 +138,4 @@ class ParagraphVectors:
         return np.asarray(Dv[0])
 
     def similarity(self, a: str, b: str) -> float:
-        va, vb = self.get_doc_vector(a), self.get_doc_vector(b)
-        if va is None or vb is None:
-            return float("nan")
-        return float(va @ vb / ((np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12))
+        return cosine_similarity(self.get_doc_vector(a), self.get_doc_vector(b))

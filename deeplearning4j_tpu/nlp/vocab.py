@@ -62,3 +62,27 @@ class VocabCache:
         f = f / max(self._total, 1)
         keep = np.minimum(1.0, np.sqrt(t / np.maximum(f, 1e-12)) + t / np.maximum(f, 1e-12))
         return keep.astype(np.float32)
+
+
+class NegativeSampler:
+    """Precomputed-CDF sampler for the unigram^0.75 distribution.
+
+    ``rng.choice(V, p=probs)`` rebuilds an O(V) CDF per call; for real
+    vocabularies that would dominate each training batch. Build the CDF once
+    and sample with searchsorted.
+    """
+
+    def __init__(self, probs: np.ndarray):
+        self._cdf = np.cumsum(np.asarray(probs, np.float64))
+        self._cdf[-1] = 1.0
+
+    def sample(self, rng, size) -> np.ndarray:
+        return np.searchsorted(self._cdf, rng.random(size)).astype(np.int32)
+
+
+def cosine_similarity(a: Optional[np.ndarray], b: Optional[np.ndarray]) -> float:
+    """Shared cosine helper (Word2Vec/Glove/ParagraphVectors .similarity)."""
+    if a is None or b is None:
+        return float("nan")
+    denom = (np.linalg.norm(a) * np.linalg.norm(b)) or 1e-12
+    return float(a @ b / denom)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
-from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.vocab import NegativeSampler, VocabCache, cosine_similarity
 
 
 def cbow_windows(encoded, window: int):
@@ -126,7 +126,7 @@ class Word2Vec:
             raise ValueError("empty vocabulary")
         self.W = ((rng.random((V, D), np.float32) - 0.5) / D)
         self.C = np.zeros((V, D), np.float32)
-        probs = self.vocab.unigram_table_probs()
+        sampler = NegativeSampler(self.vocab.unigram_table_probs())
         keep = (self.vocab.subsample_keep_probs(self.subsample)
                 if self.subsample > 0 else None)
         encoded = [self.vocab.encode(s) for s in sents]
@@ -143,8 +143,7 @@ class Word2Vec:
                 centers, ctxs = centers[order], ctxs[order]
                 B = min(self.batch_size, len(centers))
                 for s in range(0, (len(centers) // B) * B, B):
-                    negs = rng.choice(V, size=(B, self.negative),
-                                      p=probs).astype(np.int32)
+                    negs = sampler.sample(rng, (B, self.negative))
                     W, C, _ = _cbow_neg_step(W, C, jnp.asarray(ctxs[s:s + B]),
                                              jnp.asarray(centers[s:s + B]),
                                              jnp.asarray(negs), lr=self.lr)
@@ -157,8 +156,7 @@ class Word2Vec:
                 B = min(self.batch_size, len(pairs))
                 for s in range(0, (len(pairs) // B) * B, B):
                     batch = pairs[s:s + B]
-                    negs = rng.choice(V, size=(B, self.negative),
-                                      p=probs).astype(np.int32)
+                    negs = sampler.sample(rng, (B, self.negative))
                     W, C, _ = _sg_neg_step(W, C, jnp.asarray(batch[:, 0]),
                                            jnp.asarray(batch[:, 1]),
                                            jnp.asarray(negs), lr=self.lr)
@@ -171,11 +169,7 @@ class Word2Vec:
         return None if i < 0 else self.W[i]
 
     def similarity(self, a: str, b: str) -> float:
-        va, vb = self.get_word_vector(a), self.get_word_vector(b)
-        if va is None or vb is None:
-            return float("nan")
-        denom = (np.linalg.norm(va) * np.linalg.norm(vb)) or 1e-12
-        return float(va @ vb / denom)
+        return cosine_similarity(self.get_word_vector(a), self.get_word_vector(b))
 
     def words_nearest(self, word: str, top: int = 10) -> List[str]:
         """wordsNearest — cosine neighbors."""

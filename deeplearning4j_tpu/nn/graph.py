@@ -45,8 +45,11 @@ class ComputationGraph:
         for name, v in conf.vertices.items():
             if isinstance(v, LayerVertex):
                 l = v.layer
-                self._updaters[name] = (get_updater(l.updater) if l.updater is not None
-                                        else (NoOp() if not l.trainable else conf.updater))
+                # frozen wins over any per-layer updater override
+                self._updaters[name] = (NoOp() if not l.trainable
+                                        else (get_updater(l.updater)
+                                              if l.updater is not None
+                                              else conf.updater))
             else:
                 self._updaters[name] = conf.updater
 
@@ -92,10 +95,12 @@ class ComputationGraph:
     def _forward(self, params, state, inputs: dict, train, rng, masks=None,
                  want_preout=False):
         """Walk topological order. Returns (dict name->activation, new_state,
-        dict of output preouts if want_preout)."""
+        dict of output preouts if want_preout, dict of the (preprocessed)
+        features fed to each output vertex)."""
         acts = dict(inputs)
         new_state = {}
         preouts = {}
+        out_feats = {}
         for i, name in enumerate(self.conf.topological_order):
             v = self.conf.vertices[name]
             ins = [acts[d] for d in self.conf.vertex_inputs.get(name, [])]
@@ -106,6 +111,7 @@ class ComputationGraph:
             s = state.get(name, {})
             if want_preout and name in self._output_vertices and isinstance(v, LayerVertex) \
                     and hasattr(v.layer, "preout"):
+                out_feats[name] = ins[0]
                 preouts[name] = v.layer.preout(p, ins[0])
                 acts[name] = preouts[name]
                 if s:
@@ -115,7 +121,7 @@ class ComputationGraph:
             acts[name] = out
             if s2:
                 new_state[name] = s2
-        return acts, new_state, preouts
+        return acts, new_state, preouts, out_feats
 
     def _as_input_dict(self, xs):
         names = self.conf.network_inputs
@@ -133,7 +139,7 @@ class ComputationGraph:
             @jax.jit
             def fn(params, state, inputs):
                 cp = _tree_cast(params, self._policy.compute_dtype)
-                acts, _, _ = self._forward(cp, state, inputs, False, None)
+                acts, _, _, _ = self._forward(cp, state, inputs, False, None)
                 outs = [acts[n].astype(self._policy.output_dtype)
                         for n in self.conf.network_outputs]
                 return outs
@@ -144,8 +150,8 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------- fit
     def _loss(self, params, state, inputs, labels: dict, rng, masks):
-        acts, new_state, preouts = self._forward(params, state, inputs, True, rng,
-                                                 masks=masks, want_preout=True)
+        acts, new_state, preouts, out_feats = self._forward(
+            params, state, inputs, True, rng, masks=masks, want_preout=True)
         from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
 
         loss = 0.0
@@ -154,10 +160,9 @@ class ComputationGraph:
             if name in preouts and hasattr(v.layer, "score_from_preout"):
                 per = v.layer.score_from_preout(labels[name], preouts[name], None)
                 if isinstance(v.layer, CenterLossOutputLayer):
-                    feats = acts[self.conf.vertex_inputs[name][0]]
                     cscore, cstate = v.layer.center_score_and_state(
-                        params.get(name, {}), state.get(name, {}), feats,
-                        labels[name])
+                        params.get(name, {}), state.get(name, {}),
+                        out_feats[name], labels[name])
                     per = per + cscore
                     new_state[name] = cstate
                 loss = loss + per.mean()

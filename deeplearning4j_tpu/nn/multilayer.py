@@ -60,8 +60,10 @@ class MultiLayerNetwork:
         self.epoch_count = 0
         self.score_value = float("nan")
         self.listeners: list = []
-        self._updaters = [get_updater(l.updater) if l.updater is not None
-                          else (NoOp() if not l.trainable else conf.updater)
+        # frozen wins over any per-layer updater override (TransferLearning)
+        self._updaters = [NoOp() if not l.trainable
+                          else (get_updater(l.updater) if l.updater is not None
+                                else conf.updater)
                           for l in self.layers]
         self._policy = BF16 if conf.dtype in ("bf16", "bfloat16") else FLOAT32
         self._rng_key = jax.random.key(conf.seed)

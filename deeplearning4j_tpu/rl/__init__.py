@@ -1,0 +1,20 @@
+"""Reinforcement learning (RL4J equivalent).
+
+Reference analog: the `rl4j/` module — org.deeplearning4j.rl4j.learning.sync.
+qlearning.discrete.QLearningDiscreteDense (DQN with experience replay +
+target network), org.deeplearning4j.rl4j.learning.async.a3c.discrete.
+A3CDiscreteDense (async advantage actor-critic), MDP contract
+(org.deeplearning4j.rl4j.mdp.MDP), ExpReplay. TPU-first: the whole DQN
+update (batch gather, double-DQN TD target, Huber loss, gradient step) is
+ONE jitted XLA program; A3C's async workers collapse into synchronous
+batched advantage actor-critic (the async machinery existed to keep Java
+threads busy, not for learning quality).
+"""
+
+from deeplearning4j_tpu.rl.env import CartPole, MDP
+from deeplearning4j_tpu.rl.replay import ExpReplay
+from deeplearning4j_tpu.rl.dqn import QLearningDiscreteDense
+from deeplearning4j_tpu.rl.actor_critic import A2CDiscreteDense
+
+__all__ = ["MDP", "CartPole", "ExpReplay", "QLearningDiscreteDense",
+           "A2CDiscreteDense"]

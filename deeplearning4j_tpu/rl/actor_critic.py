@@ -1,0 +1,131 @@
+"""Advantage actor-critic.
+
+Reference analog: org.deeplearning4j.rl4j.learning.async.a3c.discrete.
+A3CDiscreteDense — asynchronous advantage actor-critic with worker threads
+sharing a global net. TPU-first this is synchronous batched A2C: rollouts are
+collected host-side, and one jitted program computes returns/advantages and
+the combined policy+value+entropy update (the async threads were a JVM
+throughput device, not an algorithmic requirement).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.rl.dqn import _mlp_apply, _mlp_init
+from deeplearning4j_tpu.rl.env import MDP
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("lr", "value_coef", "entropy_coef"))
+def _a2c_step(params, obs, actions, returns, lr, value_coef, entropy_coef):
+    def loss_fn(p):
+        trunk_out = _mlp_apply(p["trunk"], obs)
+        h = jax.nn.relu(trunk_out)
+        logits = h @ p["pi"]["W"] + p["pi"]["b"]
+        values = (h @ p["v"]["W"] + p["v"]["b"])[:, 0]
+        adv = returns - jax.lax.stop_gradient(values)
+        logp = jax.nn.log_softmax(logits)
+        chosen = jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
+        policy_loss = -(chosen * adv).mean()
+        value_loss = ((values - returns) ** 2).mean()
+        entropy = -(jnp.exp(logp) * logp).sum(-1).mean()
+        return policy_loss + value_coef * value_loss - entropy_coef * entropy
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    params = jax.tree_util.tree_map(lambda x, g: x - lr * g, params, grads)
+    return params, loss
+
+
+class A2CDiscreteDense:
+    def __init__(self, mdp: MDP, hidden: List[int] = (64,),
+                 gamma: float = 0.99, lr: float = 7e-3,
+                 value_coef: float = 0.5, entropy_coef: float = 0.01,
+                 rollout_episodes: int = 4, seed: int = 0):
+        self.mdp = mdp
+        self.gamma = gamma
+        self.lr = lr
+        self.value_coef = value_coef
+        self.entropy_coef = entropy_coef
+        self.rollout_episodes = rollout_episodes
+        self._rng = np.random.default_rng(seed)
+        key = jax.random.key(seed)
+        trunk = _mlp_init(key, [mdp.observation_size, *hidden])
+        h = hidden[-1]
+        k1, k2 = jax.random.split(jax.random.fold_in(key, 99))
+        self.params = {
+            "trunk": trunk,
+            "pi": {"W": jax.random.normal(k1, (h, mdp.n_actions)) * 0.01,
+                   "b": jnp.zeros(mdp.n_actions)},
+            "v": {"W": jax.random.normal(k2, (h, 1)) * 0.01, "b": jnp.zeros(1)},
+        }
+        self.episode_rewards: List[float] = []
+        self._policy_fn = jax.jit(self._logits)
+
+    def _logits(self, params, obs):
+        h = jax.nn.relu(_mlp_apply(params["trunk"], obs))
+        return h @ params["pi"]["W"] + params["pi"]["b"]
+
+    def act(self, obs, greedy: bool = False) -> int:
+        logits = np.asarray(self._policy_fn(self.params, jnp.asarray(obs[None])))[0]
+        if greedy:
+            return int(logits.argmax())
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _rollout(self):
+        obs_l, act_l, rew_l = [], [], []
+        boundaries = []
+        for _ in range(self.rollout_episodes):
+            obs = self.mdp.reset()
+            done, total = False, 0.0
+            while not done:
+                a = self.act(obs)
+                obs_l.append(obs)
+                act_l.append(a)
+                next_obs, r, done = self.mdp.step(a)
+                rew_l.append(r)
+                total += r
+                obs = next_obs
+            boundaries.append(len(rew_l))
+            self.episode_rewards.append(total)
+        # discounted returns per episode
+        returns = np.zeros(len(rew_l), np.float32)
+        start = 0
+        for end in boundaries:
+            g = 0.0
+            for t in range(end - 1, start - 1, -1):
+                g = rew_l[t] + self.gamma * g
+                returns[t] = g
+            start = end
+        return (np.asarray(obs_l, np.float32), np.asarray(act_l, np.int32),
+                returns)
+
+    def train_iteration(self) -> float:
+        obs, actions, returns = self._rollout()
+        returns_n = (returns - returns.mean()) / (returns.std() + 1e-8)
+        self.params, loss = _a2c_step(self.params, jnp.asarray(obs),
+                                      jnp.asarray(actions),
+                                      jnp.asarray(returns_n),
+                                      lr=self.lr, value_coef=self.value_coef,
+                                      entropy_coef=self.entropy_coef)
+        return float(loss)
+
+    def train(self, n_iterations: int):
+        for _ in range(n_iterations):
+            self.train_iteration()
+        return self.episode_rewards
+
+    def play_episode(self) -> float:
+        obs = self.mdp.reset()
+        total, done = 0.0, False
+        while not done:
+            obs, r, done = self.mdp.step(self.act(obs, greedy=True))
+            total += r
+        return total

@@ -1,0 +1,164 @@
+"""DQN — Q-learning with replay and target network.
+
+Reference analog: org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.
+QLearningDiscreteDense + QLConfiguration (epsilon-greedy with annealing,
+errorClamp, targetDqnUpdateFreq, doubleDQN flag). TPU-first: the entire
+update — batch forward through online+target nets, double-DQN TD target,
+Huber loss, Adam step — is one jitted XLA program with donated params.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.rl.env import MDP
+from deeplearning4j_tpu.rl.replay import ExpReplay
+
+
+def _mlp_init(key, sizes):
+    params = []
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k = jax.random.fold_in(key, i)
+        w = jax.random.normal(k, (a, b)) * jnp.sqrt(2.0 / a)
+        params.append({"W": w, "b": jnp.zeros(b)})
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["W"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("gamma", "lr", "double_dqn", "error_clamp"))
+def _dqn_step(params, opt, target_params, obs, actions, rewards, next_obs,
+              dones, gamma, lr, double_dqn, error_clamp):
+    def loss_fn(p):
+        q = _mlp_apply(p, obs)                                   # [B, A]
+        q_sa = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+        q_next_t = _mlp_apply(target_params, next_obs)
+        if double_dqn:
+            a_star = jnp.argmax(_mlp_apply(p, next_obs), axis=1)
+            q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=1)[:, 0]
+        else:
+            q_next = q_next_t.max(axis=1)
+        target = rewards + gamma * (1.0 - dones) * jax.lax.stop_gradient(q_next)
+        td = q_sa - target
+        if error_clamp > 0:  # Huber (the reference's errorClamp)
+            abs_td = jnp.abs(td)
+            loss = jnp.where(abs_td <= error_clamp,
+                             0.5 * td ** 2,
+                             error_clamp * (abs_td - 0.5 * error_clamp))
+        else:
+            loss = 0.5 * td ** 2
+        return loss.mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    # Adam
+    new_params, new_opt = [], []
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    t = opt["t"] + 1
+    for pl, ml, vl, gl in zip(params, opt["m"], opt["v"], grads):
+        nm = {k: b1 * ml[k] + (1 - b1) * gl[k] for k in pl}
+        nv = {k: b2 * vl[k] + (1 - b2) * gl[k] ** 2 for k in pl}
+        upd = {k: lr * (nm[k] / (1 - b1 ** t)) /
+               (jnp.sqrt(nv[k] / (1 - b2 ** t)) + eps) for k in pl}
+        new_params.append({k: pl[k] - upd[k] for k in pl})
+        new_opt.append((nm, nv))
+    opt = {"t": t, "m": [o[0] for o in new_opt], "v": [o[1] for o in new_opt]}
+    return new_params, opt, loss
+
+
+class QLearningDiscreteDense:
+    """DQN trainer over an MDP (QLearningDiscreteDense analog)."""
+
+    def __init__(self, mdp: MDP, hidden: List[int] = (64, 64),
+                 gamma: float = 0.99, lr: float = 1e-3,
+                 batch_size: int = 64, replay_capacity: int = 10000,
+                 min_replay: int = 200, target_update_freq: int = 100,
+                 eps_start: float = 1.0, eps_end: float = 0.05,
+                 eps_decay_steps: int = 2000, double_dqn: bool = True,
+                 error_clamp: float = 1.0, seed: int = 0):
+        self.mdp = mdp
+        self.gamma = gamma
+        self.lr = lr
+        self.batch_size = batch_size
+        self.min_replay = min_replay
+        self.target_update_freq = target_update_freq
+        self.eps_start, self.eps_end = eps_start, eps_end
+        self.eps_decay_steps = eps_decay_steps
+        self.double_dqn = double_dqn
+        self.error_clamp = error_clamp
+        self._rng = np.random.default_rng(seed)
+        sizes = [mdp.observation_size, *hidden, mdp.n_actions]
+        self.params = _mlp_init(jax.random.key(seed), sizes)
+        # real copy: params are donated into _dqn_step while target_params are
+        # passed by reference — aliased buffers would trip XLA donation checks
+        self.target_params = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), self.params)
+        self.opt = {"t": jnp.asarray(0),
+                    "m": [{k: jnp.zeros_like(v) for k, v in l.items()}
+                          for l in self.params],
+                    "v": [{k: jnp.zeros_like(v) for k, v in l.items()}
+                          for l in self.params]}
+        self.replay = ExpReplay(replay_capacity, mdp.observation_size, seed)
+        self.step_count = 0
+        self.episode_rewards: List[float] = []
+        self._q_fn = jax.jit(_mlp_apply)
+
+    # ---------------------------------------------------------------- policy
+    def epsilon(self) -> float:
+        frac = min(1.0, self.step_count / self.eps_decay_steps)
+        return self.eps_start + frac * (self.eps_end - self.eps_start)
+
+    def act(self, obs: np.ndarray, greedy: bool = False) -> int:
+        if not greedy and self._rng.random() < self.epsilon():
+            return int(self._rng.integers(self.mdp.n_actions))
+        q = self._q_fn(self.params, jnp.asarray(obs[None]))
+        return int(jnp.argmax(q[0]))
+
+    # ----------------------------------------------------------------- train
+    def train_episode(self) -> float:
+        obs = self.mdp.reset()
+        total = 0.0
+        done = False
+        while not done:
+            a = self.act(obs)
+            next_obs, r, done = self.mdp.step(a)
+            self.replay.store(obs, a, r, next_obs, done)
+            obs = next_obs
+            total += r
+            self.step_count += 1
+            if len(self.replay) >= self.min_replay:
+                o, acts, rs, no, ds = self.replay.sample(self.batch_size)
+                self.params, self.opt, _ = _dqn_step(
+                    self.params, self.opt, self.target_params,
+                    jnp.asarray(o), jnp.asarray(acts), jnp.asarray(rs),
+                    jnp.asarray(no), jnp.asarray(ds),
+                    gamma=self.gamma, lr=self.lr, double_dqn=self.double_dqn,
+                    error_clamp=self.error_clamp)
+            if self.step_count % self.target_update_freq == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    lambda x: jnp.array(x, copy=True), self.params)
+        self.episode_rewards.append(total)
+        return total
+
+    def train(self, n_episodes: int) -> List[float]:
+        return [self.train_episode() for _ in range(n_episodes)]
+
+    def play_episode(self) -> float:
+        """Greedy rollout (Policy.play analog)."""
+        obs = self.mdp.reset()
+        total, done = 0.0, False
+        while not done:
+            obs, r, done = self.mdp.step(self.act(obs, greedy=True))
+            total += r
+        return total

@@ -1,0 +1,15 @@
+"""Training UI / stats subsystem.
+
+Reference analog: deeplearning4j-ui-parent — StatsListener -> StatsStorage
+(mapdb-backed FileStatsStorage / InMemoryStatsStorage) -> UIServer web
+dashboard (SURVEY.md §5 "Metrics/observability"). TPU-first rendering is a
+dependency-free HTML report with inline SVG charts plus CSV scalar export
+(TensorBoard-compatible layout), served by a stdlib http server.
+"""
+
+from deeplearning4j_tpu.ui.storage import FileStatsStorage, InMemoryStatsStorage
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.server import UIServer, render_report
+
+__all__ = ["StatsListener", "InMemoryStatsStorage", "FileStatsStorage",
+           "UIServer", "render_report"]

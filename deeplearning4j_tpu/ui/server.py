@@ -1,0 +1,113 @@
+"""UI server + report rendering.
+
+Reference analog: org.deeplearning4j.ui.api.UIServer (Play/Vert.x web
+dashboard with loss charts). Here: dependency-free inline-SVG HTML report
+over a StatsStorage, served by a stdlib ThreadingHTTPServer — same
+attach-storage-then-browse workflow (UIServer.getInstance().attach(storage)).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+def _svg_line_chart(series: List[Tuple[float, float]], title: str,
+                    width: int = 640, height: int = 240) -> str:
+    if not series:
+        return f"<p>{title}: no data</p>"
+    xs = [p[0] for p in series]
+    ys = [p[1] for p in series]
+    x0, x1 = min(xs), max(xs) or 1
+    y0, y1 = min(ys), max(ys)
+    if y1 == y0:
+        y1 = y0 + 1
+    pad = 30
+    W, H = width - 2 * pad, height - 2 * pad
+
+    def px(x):
+        return pad + (x - x0) / (x1 - x0 or 1) * W
+
+    def py(y):
+        return pad + (1 - (y - y0) / (y1 - y0)) * H
+
+    pts = " ".join(f"{px(x):.1f},{py(y):.1f}" for x, y in series)
+    return (
+        f'<h3>{title}</h3>'
+        f'<svg width="{width}" height="{height}" '
+        f'style="background:#fafafa;border:1px solid #ddd">'
+        f'<polyline fill="none" stroke="#1f77b4" stroke-width="1.5" points="{pts}"/>'
+        f'<text x="{pad}" y="{pad - 8}" font-size="11">max {y1:.5g}</text>'
+        f'<text x="{pad}" y="{height - 8}" font-size="11">min {y0:.5g}</text>'
+        f"</svg>"
+    )
+
+
+def render_report(storage: StatsStorage, session_id: Optional[str] = None) -> str:
+    """Full HTML dashboard for one (or every) session."""
+    sessions = ([session_id] if session_id else storage.session_ids())
+    parts = ["<html><head><title>deeplearning4j_tpu training UI</title></head>"
+             "<body><h1>Training dashboard</h1>"]
+    for sid in sessions:
+        parts.append(f"<h2>session: {sid}</h2>")
+        recs = storage.records(sid)
+        keys = sorted({k for r in recs for k, v in r.items()
+                       if isinstance(v, (int, float))
+                       and k not in ("iteration", "epoch", "timestamp",
+                                     "epoch_end")})
+        for k in keys:
+            parts.append(_svg_line_chart(storage.scalars(k, sid), k))
+        parts.append(f"<p>{len(recs)} records</p>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+class UIServer:
+    """Minimal dashboard server (UIServer.getInstance().attach(storage))."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._storages: List[StatsStorage] = []
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def attach(self, storage: StatsStorage) -> "UIServer":
+        self._storages.append(storage)
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> "UIServer":
+        storages = self._storages
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                body = "".join(render_report(s) for s in storages) or (
+                    "<html><body>no storage attached</body></html>")
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

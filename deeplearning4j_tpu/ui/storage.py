@@ -1,0 +1,90 @@
+"""Stats storage backends.
+
+Reference analog: org.deeplearning4j.ui.storage.{InMemoryStatsStorage,
+FileStatsStorage} implementing the StatsStorage API the UI reads. Records
+are flat dicts; FileStatsStorage appends JSONL (replacing mapdb).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional
+
+
+class StatsStorage:
+    def put(self, record: Dict) -> None:
+        raise NotImplementedError
+
+    def records(self, session_id: Optional[str] = None) -> List[Dict]:
+        raise NotImplementedError
+
+    def session_ids(self) -> List[str]:
+        return sorted({r.get("session", "default") for r in self.records()})
+
+    def scalars(self, key: str, session_id: Optional[str] = None):
+        """(iteration, value) series for one scalar key."""
+        out = [(r["iteration"], r[key]) for r in self.records(session_id)
+               if key in r and r[key] is not None]
+        return sorted(out)
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._records: List[Dict] = []
+        self._lock = threading.Lock()
+
+    def put(self, record: Dict) -> None:
+        with self._lock:
+            self._records.append(dict(record))
+
+    def records(self, session_id=None) -> List[Dict]:
+        with self._lock:
+            rs = list(self._records)
+        if session_id is not None:
+            rs = [r for r in rs if r.get("session", "default") == session_id]
+        return rs
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL file store."""
+
+    def __init__(self, path: str | Path):
+        self._path = Path(path)
+        self._lock = threading.Lock()
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        if not self._path.exists():
+            self._path.touch()
+
+    def put(self, record: Dict) -> None:
+        line = json.dumps(record)
+        with self._lock:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+
+    def records(self, session_id=None) -> List[Dict]:
+        with self._lock:
+            text = self._path.read_text()
+        rs = [json.loads(l) for l in text.splitlines() if l.strip()]
+        if session_id is not None:
+            rs = [r for r in rs if r.get("session", "default") == session_id]
+        return rs
+
+    def export_csv(self, directory: str | Path) -> List[Path]:
+        """One CSV per scalar key (TensorBoard-style scalars layout)."""
+        directory = Path(directory)
+        directory.mkdir(parents=True, exist_ok=True)
+        keys = set()
+        for r in self.records():
+            keys.update(k for k, v in r.items()
+                        if isinstance(v, (int, float)) and k != "iteration")
+        written = []
+        for k in sorted(keys):
+            p = directory / f"{k}.csv"
+            with open(p, "w") as f:
+                f.write("iteration,value\n")
+                for it, v in self.scalars(k):
+                    f.write(f"{it},{v}\n")
+            written.append(p)
+        return written

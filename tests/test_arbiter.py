@@ -1,0 +1,109 @@
+"""Arbiter hyperparameter-search tests."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace, DiscreteParameterSpace, GridSearchGenerator,
+    IntegerParameterSpace, MaxCandidatesCondition, MaxTimeCondition,
+    OptimizationRunner, RandomSearchGenerator,
+)
+
+
+class TestSpaces:
+    def test_continuous(self):
+        rng = np.random.default_rng(0)
+        s = ContinuousParameterSpace(0.1, 10.0, log_scale=True)
+        vals = [s.sample(rng) for _ in range(100)]
+        assert all(0.1 <= v <= 10.0 for v in vals)
+        g = s.grid(3)
+        assert g[0] == pytest.approx(0.1) and g[-1] == pytest.approx(10.0)
+        assert g[1] == pytest.approx(1.0)  # log midpoint
+
+    def test_integer_grid(self):
+        s = IntegerParameterSpace(1, 10)
+        assert s.grid(100) == list(range(1, 11))
+        assert set(s.grid(3)) <= set(range(1, 11))
+
+    def test_discrete(self):
+        s = DiscreteParameterSpace(["a", "b"])
+        assert s.grid() == ["a", "b"]
+
+
+class TestGenerators:
+    def test_grid_product(self):
+        gen = GridSearchGenerator({"x": DiscreteParameterSpace([1, 2]),
+                                   "y": DiscreteParameterSpace(["a", "b"])})
+        combos = list(gen)
+        assert len(combos) == 4
+        assert {"x": 1, "y": "a"} in combos
+
+    def test_random_infinite(self):
+        gen = iter(RandomSearchGenerator({"x": IntegerParameterSpace(0, 5)},
+                                         seed=1))
+        vals = [next(gen)["x"] for _ in range(20)]
+        assert all(0 <= v <= 5 for v in vals)
+        assert len(set(vals)) > 1
+
+
+class TestRunner:
+    def test_quadratic_minimum(self):
+        # find x near 3 minimizing (x-3)^2
+        runner = OptimizationRunner(
+            RandomSearchGenerator({"x": ContinuousParameterSpace(-10, 10)},
+                                  seed=0),
+            build_fn=lambda hp: hp["x"],
+            score_fn=lambda x: (x - 3.0) ** 2,
+            termination_conditions=[MaxCandidatesCondition(200)],
+        )
+        best = runner.execute()
+        assert abs(best.hyperparams["x"] - 3.0) < 0.5
+        assert len(runner.results) == 200
+        assert runner.best().score == best.score
+
+    def test_max_time_condition(self):
+        import itertools as it
+
+        runner = OptimizationRunner(
+            RandomSearchGenerator({"x": ContinuousParameterSpace(0, 1)}),
+            build_fn=lambda hp: hp["x"],
+            score_fn=lambda x: x,
+            termination_conditions=[MaxTimeCondition(0.0)],
+        )
+        with pytest.raises(RuntimeError):
+            runner.execute()  # no candidate evaluated before timeout
+
+    def test_model_search(self, rng):
+        """End-to-end: search hidden width + lr for a tiny classifier."""
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optimize import Sgd
+
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        w = rng.normal(size=(4, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+        def build(hp):
+            conf = (NeuralNetConfiguration.builder().seed(1)
+                    .updater(Sgd(lr=hp["lr"])).list()
+                    .layer(DenseLayer(n_out=hp["width"], activation="relu"))
+                    .layer(OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            model = MultiLayerNetwork(conf).init()
+            for _ in range(30):
+                model.fit_batch((x, y))
+            return model
+
+        runner = OptimizationRunner(
+            GridSearchGenerator({"width": DiscreteParameterSpace([4, 16]),
+                                 "lr": DiscreteParameterSpace([0.001, 0.3])}),
+            build_fn=build,
+            score_fn=lambda m: m.score((x, y)),
+            termination_conditions=[MaxCandidatesCondition(4)],
+        )
+        best = runner.execute()
+        assert len(runner.results) == 4
+        # the sane lr clearly beats lr=0.001 in 30 steps
+        assert best.hyperparams["lr"] == 0.3

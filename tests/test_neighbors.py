@@ -1,0 +1,94 @@
+"""Nearest-neighbor + graph-learning tests.
+
+Reference analog: VPTree/KDTree unit tests in
+deeplearning4j-nearestneighbors-parent and DeepWalk tests in
+deeplearning4j-graph. Trees are checked against exhaustive search.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graphlearn import DeepWalk, Graph
+from deeplearning4j_tpu.neighbors import KDTree, VPTree, knn_search
+
+
+def _brute(points, q, k, metric="euclidean"):
+    if metric == "euclidean":
+        d = np.linalg.norm(points - q, axis=1)
+    elif metric == "cosine":
+        pn = points / np.linalg.norm(points, axis=1, keepdims=True)
+        d = 1 - pn @ (q / np.linalg.norm(q))
+    order = np.argsort(d)[:k]
+    return order, d[order]
+
+
+class TestVPTree:
+    @pytest.mark.parametrize("metric", ["euclidean", "cosine", "manhattan"])
+    def test_matches_bruteforce(self, rng, metric):
+        pts = rng.normal(size=(200, 8))
+        tree = VPTree(pts, distance=metric)
+        for _ in range(10):
+            q = rng.normal(size=(8,))
+            idx, dist = tree.knn(q, k=5)
+            if metric == "manhattan":
+                d = np.abs(pts - q).sum(1)
+                ref = np.argsort(d)[:5]
+            else:
+                ref, _ = _brute(pts, q, 5, metric)
+            assert set(idx) == set(ref.tolist())
+            assert dist == sorted(dist)
+
+
+class TestKDTree:
+    def test_matches_bruteforce(self, rng):
+        pts = rng.normal(size=(300, 4))
+        tree = KDTree(pts)
+        for _ in range(10):
+            q = rng.normal(size=(4,))
+            idx, dist = tree.knn(q, k=3)
+            ref, refd = _brute(pts, q, 3)
+            assert set(idx) == set(ref.tolist())
+            np.testing.assert_allclose(dist, refd, rtol=1e-9)
+
+    def test_nearest(self, rng):
+        pts = rng.normal(size=(50, 3))
+        tree = KDTree(pts)
+        i, d = tree.nearest(pts[17] + 1e-9)
+        assert i == 17
+
+
+class TestDeviceKnn:
+    @pytest.mark.parametrize("metric", ["euclidean", "cosine", "manhattan"])
+    def test_matches_bruteforce(self, rng, metric):
+        pts = rng.normal(size=(128, 16)).astype(np.float32)
+        qs = rng.normal(size=(4, 16)).astype(np.float32)
+        idx, dist = knn_search(pts, qs, k=4, metric=metric)
+        assert idx.shape == (4, 4)
+        for qi in range(4):
+            if metric == "manhattan":
+                d = np.abs(pts - qs[qi]).sum(1)
+                ref = np.argsort(d)[:4]
+            else:
+                ref, _ = _brute(pts, qs[qi], 4, metric)
+            assert set(idx[qi].tolist()) == set(ref.tolist())
+
+
+class TestDeepWalk:
+    def test_two_cliques(self):
+        # two dense cliques joined by one bridge edge: embeddings should
+        # cluster by clique
+        edges = []
+        for a in range(5):
+            for b in range(a + 1, 5):
+                edges.append((a, b))
+                edges.append((a + 5, b + 5))
+        edges.append((0, 5))
+        g = Graph.from_edges(edges, n_vertices=10)
+        dw = DeepWalk(vector_size=16, window=3, walk_length=10,
+                      walks_per_vertex=20, epochs=5, learning_rate=0.01,
+                      seed=4).fit(g)
+        assert dw.get_vertex_vector(0).shape == (16,)
+        # in-clique similarity beats cross-clique (excluding bridge nodes)
+        sim_in = dw.similarity(1, 2)
+        sim_out = dw.similarity(1, 7)
+        assert sim_in > sim_out

@@ -94,9 +94,7 @@ class TestParagraphVectors:
                  "a dog and a cat played with the fish"] * 4
                 + ["stocks rallied as the market closed higher",
                    "investors bought stocks in heavy market trading"] * 4)
-        labels = [f"animal_{i}" if i % 2 == 0 or i < 8 else f"fin_{i}"
-                  for i in range(len(docs))]
-        # simpler: first 8 animal docs, last 8 finance docs
+        # first 8 animal docs, last 8 finance docs
         labels = [f"animal_{i}" if i < 8 else f"fin_{i}" for i in range(len(docs))]
         pv = ParagraphVectors(vector_size=24, window=3, negative=4, epochs=30,
                               learning_rate=0.08, seed=11).fit(docs, labels)

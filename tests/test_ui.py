@@ -1,0 +1,73 @@
+"""UI/stats subsystem tests.
+
+Reference analog: deeplearning4j-ui tests — StatsListener populates
+StatsStorage; UIServer serves the dashboard.
+"""
+
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import Sgd
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, UIServer,
+    render_report,
+)
+
+
+def _train(storage, iters=12):
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(lr=0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    model = MultiLayerNetwork(conf).init()
+    model.set_listeners(StatsListener(storage, session_id="s1",
+                                      update_frequency=5))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    for _ in range(iters):
+        model.fit_batch((x, y))
+    return model
+
+
+class TestStatsStorage:
+    def test_in_memory_collects(self):
+        storage = InMemoryStatsStorage()
+        _train(storage)
+        recs = storage.records("s1")
+        assert len(recs) == 12
+        scores = storage.scalars("score", "s1")
+        assert len(scores) == 12
+        assert all(np.isfinite(v) for _, v in scores)
+        # param stats sampled at update_frequency
+        sampled = [r for r in recs if "params_mean_magnitude" in r]
+        assert len(sampled) >= 2
+
+    def test_file_storage_and_csv_export(self, tmp_path):
+        storage = FileStatsStorage(tmp_path / "stats.jsonl")
+        _train(storage, iters=6)
+        assert len(storage.records()) == 6
+        files = storage.export_csv(tmp_path / "scalars")
+        names = {f.name for f in files}
+        assert "score.csv" in names
+        text = (tmp_path / "scalars" / "score.csv").read_text()
+        assert text.startswith("iteration,value\n")
+        assert len(text.splitlines()) == 7
+
+
+class TestUIServer:
+    def test_render_and_serve(self):
+        storage = InMemoryStatsStorage()
+        _train(storage, iters=5)
+        html = render_report(storage)
+        assert "<svg" in html and "score" in html
+        server = UIServer(port=0).attach(storage).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/"
+            body = urllib.request.urlopen(url, timeout=10).read().decode()
+            assert "Training dashboard" in body and "<svg" in body
+        finally:
+            server.stop()
