@@ -105,9 +105,15 @@ class OptimizationRunner:
             if np.isfinite(score) and better:
                 best = res
         if best is None:
+            if self.results:
+                raise RuntimeError(
+                    f"all {len(self.results)} candidate scores were non-finite")
             raise RuntimeError("no candidates evaluated")
         return best
 
     def best(self) -> OptimizationResult:
+        finite = [r for r in self.results if np.isfinite(r.score)]
+        if not finite:
+            raise RuntimeError("no finite-scored candidates")
         key = (lambda r: r.score) if self.minimize else (lambda r: -r.score)
-        return min(self.results, key=key)
+        return min(finite, key=key)

@@ -36,7 +36,7 @@ class Graph:
         return len(self.adj[v])
 
     def random_walks(self, walk_length: int, walks_per_vertex: int = 1,
-                     seed: int = 0) -> np.ndarray:
+                     seed: int = 0) -> List[List[int]]:
         """Uniform random walks from every vertex
         (RandomWalkIterator semantics; walks stop early at sinks)."""
         rng = np.random.default_rng(seed)

@@ -1,0 +1,16 @@
+"""Native runtime bindings (ctypes over native/dl4jtpu_native.cpp).
+
+Reference analog (SURVEY.md §2.1): libnd4j's workspace allocator
+(memory::Workspace) and the prefetch queues of AsyncDataSetIterator /
+ParallelWrapper — the host-side runtime around the device compute path. The
+library is compiled lazily with g++ on first use (no pybind11 in the image;
+plain C ABI + ctypes). Every entry point has a pure-Python fallback so the
+framework works where no toolchain exists.
+"""
+
+from deeplearning4j_tpu.native.lib import load_native_lib, native_available
+from deeplearning4j_tpu.native.workspace import Workspace
+from deeplearning4j_tpu.native.pipeline import NativeDataSetIterator, write_binary_dataset
+
+__all__ = ["load_native_lib", "native_available", "Workspace",
+           "NativeDataSetIterator", "write_binary_dataset"]

@@ -1,0 +1,84 @@
+"""Lazy build + load of the native library."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_ROOT = Path(__file__).resolve().parent.parent.parent
+_SRC = _ROOT / "native" / "dl4jtpu_native.cpp"
+_SO = _ROOT / "native" / "build" / "libdl4jtpu.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    _SO.parent.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+           "-shared", "-o", str(_SO), str(_SRC)]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return False
+    if res.returncode != 0:
+        import warnings
+
+        warnings.warn(f"native build failed:\n{res.stderr[-2000:]}")
+        return False
+    return True
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.dl4j_ws_create.restype = c.c_void_p
+    lib.dl4j_ws_create.argtypes = [c.c_size_t]
+    lib.dl4j_ws_alloc.restype = c.c_void_p
+    lib.dl4j_ws_alloc.argtypes = [c.c_void_p, c.c_size_t, c.c_size_t]
+    lib.dl4j_ws_reset.argtypes = [c.c_void_p]
+    lib.dl4j_ws_used.restype = c.c_size_t
+    lib.dl4j_ws_used.argtypes = [c.c_void_p]
+    lib.dl4j_ws_peak.restype = c.c_size_t
+    lib.dl4j_ws_peak.argtypes = [c.c_void_p]
+    lib.dl4j_ws_spilled.restype = c.c_size_t
+    lib.dl4j_ws_spilled.argtypes = [c.c_void_p]
+    lib.dl4j_ws_destroy.argtypes = [c.c_void_p]
+
+    lib.dl4j_pipe_create.restype = c.c_void_p
+    lib.dl4j_pipe_create.argtypes = [c.c_char_p, c.c_char_p, c.c_long,
+                                     c.c_long, c.c_long, c.c_long, c.c_int,
+                                     c.c_uint, c.c_int, c.c_int]
+    lib.dl4j_pipe_next.restype = c.c_int
+    lib.dl4j_pipe_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                   c.POINTER(c.c_float)]
+    lib.dl4j_pipe_reset.argtypes = [c.c_void_p]
+    lib.dl4j_pipe_batches_per_epoch.restype = c.c_long
+    lib.dl4j_pipe_batches_per_epoch.argtypes = [c.c_void_p]
+    lib.dl4j_pipe_destroy.argtypes = [c.c_void_p]
+    return lib
+
+
+def load_native_lib() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried and not _SO.exists():
+            return _lib
+        _tried = True
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            _lib = _declare(ctypes.CDLL(str(_SO)))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native_lib() is not None

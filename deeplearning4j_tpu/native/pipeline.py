@@ -1,0 +1,143 @@
+"""Native prefetching DataSet iterator.
+
+Reference analog: AsyncDataSetIterator + ParallelWrapper's prefetch queues
+(org.deeplearning4j.datasets.iterator.AsyncDataSetIterator) — producer
+threads keeping batches ahead of the training step, implemented in C++
+(native/dl4jtpu_native.cpp) instead of Java threads. Falls back to a numpy
+implementation when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.native.lib import load_native_lib
+
+
+def write_binary_dataset(directory, features: np.ndarray, labels: np.ndarray
+                         ) -> Tuple[str, str]:
+    """Flat-float32 export consumed by the native pipeline (the interchange
+    format standing in for the reference's DataSet binary serialization)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    f = directory / "features.bin"
+    l = directory / "labels.bin"
+    np.ascontiguousarray(features, np.float32).tofile(f)
+    np.ascontiguousarray(labels, np.float32).tofile(l)
+    return str(f), str(l)
+
+
+class NativeDataSetIterator:
+    """Iterates (features, labels) batches assembled by native worker threads.
+
+    features file: [n, feat_dim] float32, labels file: [n, label_dim].
+    Drop-last semantics; reshuffles per epoch when shuffle=True.
+    """
+
+    def __init__(self, feat_path: str, label_path: str, n: int,
+                 feat_shape, label_shape, batch_size: int,
+                 shuffle: bool = True, seed: int = 0, n_threads: int = 2,
+                 queue_cap: int = 4):
+        self.feat_shape = tuple(feat_shape)
+        self.label_shape = tuple(label_shape)
+        self.feat_dim = int(np.prod(self.feat_shape))
+        self.label_dim = int(np.prod(self.label_shape))
+        self.batch_size = batch_size
+        self.n = n
+        self._lib = load_native_lib()
+        self._handle = None
+        self._fallback: Optional[_PyPipeline] = None
+        if self._lib is not None:
+            self._handle = self._lib.dl4j_pipe_create(
+                feat_path.encode(), label_path.encode(), n, self.feat_dim,
+                self.label_dim, batch_size, int(shuffle), seed, n_threads,
+                queue_cap)
+        if self._handle is None:
+            self._fallback = _PyPipeline(feat_path, label_path, n,
+                                         self.feat_dim, self.label_dim,
+                                         batch_size, shuffle, seed)
+        self._feat_buf = np.empty((batch_size, self.feat_dim), np.float32)
+        self._label_buf = np.empty((batch_size, self.label_dim), np.float32)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def batches_per_epoch(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.dl4j_pipe_batches_per_epoch(self._handle))
+        return self._fallback.n_batches
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> DataSet:
+        if self._handle is not None:
+            rc = self._lib.dl4j_pipe_next(
+                self._handle,
+                self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc == 1:
+                raise StopIteration
+            if rc != 0:
+                raise RuntimeError("native pipeline error")
+            f = self._feat_buf.reshape((self.batch_size,) + self.feat_shape).copy()
+            y = self._label_buf.reshape((self.batch_size,) + self.label_shape).copy()
+            return DataSet(f, y)
+        return self._fallback.next(self.feat_shape, self.label_shape)
+
+    def reset(self):
+        if self._handle is not None:
+            self._lib.dl4j_pipe_reset(self._handle)
+        else:
+            self._fallback.reset()
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dl4j_pipe_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _PyPipeline:
+    """Pure-python fallback with identical semantics."""
+
+    def __init__(self, feat_path, label_path, n, feat_dim, label_dim,
+                 batch, shuffle, seed):
+        self.feats = np.fromfile(feat_path, np.float32).reshape(n, feat_dim)
+        self.labels = np.fromfile(label_path, np.float32).reshape(n, label_dim)
+        self.batch = batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.n_batches = n // batch
+        self._reshuffle()
+
+    def _reshuffle(self):
+        self.order = np.arange(len(self.feats))
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(self.order)
+        self.pos = 0
+
+    def next(self, feat_shape, label_shape) -> DataSet:
+        if self.pos >= self.n_batches:
+            raise StopIteration
+        idx = self.order[self.pos * self.batch:(self.pos + 1) * self.batch]
+        self.pos += 1
+        return DataSet(
+            self.feats[idx].reshape((self.batch,) + tuple(feat_shape)).copy(),
+            self.labels[idx].reshape((self.batch,) + tuple(label_shape)).copy())
+
+    def reset(self):
+        self.epoch += 1
+        self._reshuffle()

@@ -13,11 +13,12 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+# "cosine" is accepted but handled by normalizing + euclidean search in the
+# constructor (cosine itself breaks the triangle inequality VP pruning needs)
 _DISTANCES = {
     "euclidean": lambda a, b: np.linalg.norm(a - b, axis=-1),
     "manhattan": lambda a, b: np.abs(a - b).sum(axis=-1),
-    "cosine": lambda a, b: 1.0 - (a * b).sum(-1) / (
-        np.maximum(np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1), 1e-12)),
+    "cosine": None,
 }
 
 

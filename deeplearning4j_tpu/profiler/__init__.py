@@ -1,0 +1,16 @@
+"""Profiling / tracing / numerics panic.
+
+Reference analog (SURVEY.md §5): ND4J OpProfiler
+(org.nd4j.linalg.profiler.OpProfiler with ProfilerConfig NaN/Inf panic
+modes), DL4J PerformanceListener, libnd4j GraphProfile. TPU-first the
+per-op timeline comes from jax.profiler (XLA's own instrumentation); this
+module adds the OpProfiler-style aggregation, step timing, and the
+NaN-panic mode (jax_debug_nans + an explicit check_numerics for pytrees).
+"""
+
+from deeplearning4j_tpu.profiler.profiler import (
+    OpProfiler, ProfilerConfig, check_numerics, nan_panic, trace,
+)
+
+__all__ = ["OpProfiler", "ProfilerConfig", "check_numerics", "nan_panic",
+           "trace"]

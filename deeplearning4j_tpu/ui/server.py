@@ -12,7 +12,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
-from deeplearning4j_tpu.ui.storage import StatsStorage
+from deeplearning4j_tpu.ui.storage import NON_SCALAR_KEYS, StatsStorage
 
 
 def _svg_line_chart(series: List[Tuple[float, float]], title: str,
@@ -21,7 +21,7 @@ def _svg_line_chart(series: List[Tuple[float, float]], title: str,
         return f"<p>{title}: no data</p>"
     xs = [p[0] for p in series]
     ys = [p[1] for p in series]
-    x0, x1 = min(xs), max(xs) or 1
+    x0, x1 = min(xs), max(xs)
     y0, y1 = min(ys), max(ys)
     if y1 == y0:
         y1 = y0 + 1
@@ -56,8 +56,7 @@ def render_report(storage: StatsStorage, session_id: Optional[str] = None) -> st
         recs = storage.records(sid)
         keys = sorted({k for r in recs for k, v in r.items()
                        if isinstance(v, (int, float))
-                       and k not in ("iteration", "epoch", "timestamp",
-                                     "epoch_end")})
+                       and k not in NON_SCALAR_KEYS})
         for k in keys:
             parts.append(_svg_line_chart(storage.scalars(k, sid), k))
         parts.append(f"<p>{len(recs)} records</p>")
@@ -88,6 +87,10 @@ class UIServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] not in ("/", "/index.html"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
                 body = "".join(render_report(s) for s in storages) or (
                     "<html><body>no storage attached</body></html>")
                 data = body.encode()

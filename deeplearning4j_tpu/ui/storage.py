@@ -13,6 +13,10 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 
+# bookkeeping fields that are not chartable scalar series
+NON_SCALAR_KEYS = ("iteration", "epoch", "timestamp", "epoch_end")
+
+
 class StatsStorage:
     def put(self, record: Dict) -> None:
         raise NotImplementedError
@@ -78,7 +82,8 @@ class FileStatsStorage(StatsStorage):
         keys = set()
         for r in self.records():
             keys.update(k for k, v in r.items()
-                        if isinstance(v, (int, float)) and k != "iteration")
+                        if isinstance(v, (int, float))
+                        and k not in NON_SCALAR_KEYS)
         written = []
         for k in sorted(keys):
             p = directory / f"{k}.csv"

@@ -1,0 +1,94 @@
+"""Sharded / async checkpoints (orbax).
+
+Reference analog (SURVEY.md §5 "Checkpoint / resume"): ModelSerializer's
+zip (configuration.json + coefficients.bin + updaterState.bin) covers
+interchange — that lives in util.serialization. This module covers the
+*training* checkpoint path the reference lacks at TPU scale: step-indexed
+async checkpoints of {params, opt_state, step} with keep-last-N retention,
+written with orbax so multi-host sharded arrays save/restore correctly.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+def _manager(directory: str, keep_last: int, async_save: bool):
+    import orbax.checkpoint as ocp
+
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=keep_last, enable_async_checkpointing=async_save)
+    return ocp.CheckpointManager(Path(directory).absolute(), options=options)
+
+
+class TrainingCheckpointer:
+    """Step-indexed {params, opt_state, step} checkpoints.
+
+        ckpt = TrainingCheckpointer(dir, keep_last=3)
+        ckpt.save(step, model)           # async by default
+        step = ckpt.restore_latest(model)  # in-place restore, returns step
+    """
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 async_save: bool = True):
+        self.directory = str(directory)
+        self._mgr = _manager(self.directory, keep_last, async_save)
+
+    def save(self, step: int, model) -> None:
+        import orbax.checkpoint as ocp
+
+        payload = {"params": model.params, "state": model.state,
+                   "opt_state": model.opt_state}
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, model) -> Optional[int]:
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, model)
+
+    def restore(self, step: int, model) -> int:
+        import orbax.checkpoint as ocp
+
+        template = {"params": model.params, "state": model.state,
+                    "opt_state": model.opt_state}
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template))
+        model.params = restored["params"]
+        model.state = restored["state"]
+        model.opt_state = restored["opt_state"]
+        model.step_count = int(step)
+        return int(step)
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+class AsyncCheckpointListener(TrainingListener):
+    """Listener wiring the checkpointer into fit() (CheckpointListener's
+    role, with async sharded saves instead of zip writes)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int = 1000,
+                 keep_last: int = 3):
+        self.checkpointer = TrainingCheckpointer(directory, keep_last)
+        self.every = max(1, save_every_n_iterations)
+
+    def iteration_done(self, model, iteration: int, epoch: int, score: float):
+        if iteration > 0 and iteration % self.every == 0:
+            self.checkpointer.save(iteration, model)
+
+    def on_epoch_end(self, model, epoch: int):
+        self.checkpointer.wait()

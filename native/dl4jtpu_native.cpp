@@ -1,0 +1,252 @@
+// Native runtime pieces: arena workspace allocator + threaded prefetching
+// batch pipeline, exposed through a C ABI consumed via ctypes.
+//
+// Reference analog (SURVEY.md §2.1): libnd4j's memory::Workspace
+// (libnd4j/include/memory/) and the Java-side prefetch machinery
+// (AsyncDataSetIterator / ParallelWrapper's MagicQueue). TPU-first split:
+// device memory belongs to XLA (buffer donation), so the native layer owns
+// exactly what XLA does not — host-side staging arenas and the producer
+// threads that keep the input pipeline ahead of the device step.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- workspace
+// Bump-pointer arena with reset semantics (Workspace::allocateBytes /
+// scope-reset). Not thread-safe by design — one workspace per thread, as in
+// the reference.
+struct Workspace {
+  std::vector<uint8_t> buf;
+  size_t offset;
+  size_t peak;       // high-water mark across resets (used for spill stats)
+  size_t spilled;    // bytes served by malloc because the arena was full
+  std::vector<void*> spill_ptrs;
+};
+
+void* dl4j_ws_create(size_t bytes) {
+  auto* ws = new (std::nothrow) Workspace();
+  if (!ws) return nullptr;
+  ws->buf.resize(bytes);
+  ws->offset = 0;
+  ws->peak = 0;
+  ws->spilled = 0;
+  return ws;
+}
+
+void* dl4j_ws_alloc(void* handle, size_t bytes, size_t align) {
+  auto* ws = static_cast<Workspace*>(handle);
+  if (align == 0) align = 64;
+  // align the absolute address, not the offset (the base allocation is not
+  // necessarily 64-byte aligned)
+  uintptr_t base = reinterpret_cast<uintptr_t>(ws->buf.data());
+  uintptr_t addr = (base + ws->offset + align - 1) & ~(uintptr_t)(align - 1);
+  size_t aligned = addr - base;
+  if (aligned + bytes > ws->buf.size()) {
+    // spill to heap (the reference's EXTERNAL allocation policy)
+    void* p = ::operator new(bytes, std::nothrow);
+    if (p) {
+      ws->spilled += bytes;
+      ws->spill_ptrs.push_back(p);
+    }
+    return p;
+  }
+  ws->offset = aligned + bytes;
+  if (ws->offset > ws->peak) ws->peak = ws->offset;
+  return ws->buf.data() + aligned;
+}
+
+void dl4j_ws_reset(void* handle) {
+  auto* ws = static_cast<Workspace*>(handle);
+  ws->offset = 0;
+  for (void* p : ws->spill_ptrs) ::operator delete(p);
+  ws->spill_ptrs.clear();
+  ws->spilled = 0;
+}
+
+size_t dl4j_ws_used(void* handle) {
+  return static_cast<Workspace*>(handle)->offset;
+}
+
+size_t dl4j_ws_peak(void* handle) {
+  return static_cast<Workspace*>(handle)->peak;
+}
+
+size_t dl4j_ws_spilled(void* handle) {
+  return static_cast<Workspace*>(handle)->spilled;
+}
+
+void dl4j_ws_destroy(void* handle) {
+  auto* ws = static_cast<Workspace*>(handle);
+  dl4j_ws_reset(handle);
+  delete ws;
+}
+
+// ----------------------------------------------------------------- pipeline
+// Threaded prefetching batcher over two flat float32 binary files
+// (features [n, feat_dim], labels [n, label_dim]). Workers assemble shuffled
+// batches into a bounded queue; the consumer copies into caller buffers.
+struct Batch {
+  std::vector<float> feats;
+  std::vector<float> labels;
+};
+
+struct Pipeline {
+  std::vector<float> feats;   // memory-resident dataset (host staging)
+  std::vector<float> labels;
+  long n, feat_dim, label_dim, batch;
+  bool shuffle;
+  unsigned seed;
+  int queue_cap;
+  unsigned epoch;
+
+  std::vector<long> order;
+  std::atomic<long> cursor;      // next batch index to produce
+  long n_batches;
+
+  std::deque<Batch> queue;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop;
+  std::atomic<long> produced;    // batches pushed this epoch
+
+  void make_order() {
+    order.resize(n);
+    for (long i = 0; i < n; ++i) order[i] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed + epoch);
+      for (long i = n - 1; i > 0; --i) {
+        long j = static_cast<long>(rng() % static_cast<uint64_t>(i + 1));
+        std::swap(order[i], order[j]);
+      }
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      long b = cursor.fetch_add(1);
+      if (b >= n_batches || stop.load()) return;
+      Batch batch;
+      batch.feats.resize(static_cast<size_t>(this->batch) * feat_dim);
+      batch.labels.resize(static_cast<size_t>(this->batch) * label_dim);
+      for (long r = 0; r < this->batch; ++r) {
+        long src = order[b * this->batch + r];
+        std::memcpy(batch.feats.data() + r * feat_dim,
+                    feats.data() + src * feat_dim, feat_dim * sizeof(float));
+        std::memcpy(batch.labels.data() + r * label_dim,
+                    labels.data() + src * label_dim, label_dim * sizeof(float));
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_produce.wait(lk, [&] {
+        return stop.load() || queue.size() < static_cast<size_t>(queue_cap);
+      });
+      if (stop.load()) return;
+      queue.push_back(std::move(batch));
+      produced.fetch_add(1);
+      cv_consume.notify_one();
+    }
+  }
+
+  void start_workers(int n_threads) {
+    stop.store(false);
+    cursor.store(0);
+    produced.store(0);
+    for (int i = 0; i < n_threads; ++i)
+      workers.emplace_back([this] { worker(); });
+  }
+
+  void join_workers() {
+    stop.store(true);
+    cv_produce.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+  }
+};
+
+static bool read_file(const char* path, std::vector<float>& out, size_t count) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  out.resize(count);
+  size_t got = std::fread(out.data(), sizeof(float), count, f);
+  std::fclose(f);
+  return got == count;
+}
+
+void* dl4j_pipe_create(const char* feat_path, const char* label_path, long n,
+                       long feat_dim, long label_dim, long batch, int shuffle,
+                       unsigned seed, int n_threads, int queue_cap) {
+  if (n <= 0 || batch <= 0 || feat_dim <= 0 || label_dim <= 0) return nullptr;
+  auto* p = new (std::nothrow) Pipeline();
+  if (!p) return nullptr;
+  if (!read_file(feat_path, p->feats, static_cast<size_t>(n) * feat_dim) ||
+      !read_file(label_path, p->labels, static_cast<size_t>(n) * label_dim)) {
+    delete p;
+    return nullptr;
+  }
+  p->n = n;
+  p->feat_dim = feat_dim;
+  p->label_dim = label_dim;
+  p->batch = batch;
+  p->shuffle = shuffle != 0;
+  p->seed = seed;
+  p->epoch = 0;
+  p->queue_cap = queue_cap > 0 ? queue_cap : 4;
+  p->n_batches = n / batch;  // drop last partial, as the reference iterators do
+  p->make_order();
+  p->start_workers(n_threads > 0 ? n_threads : 2);
+  return p;
+}
+
+// 0 = batch delivered; 1 = epoch exhausted (call reset); -1 = error
+int dl4j_pipe_next(void* handle, float* feat_out, float* label_out) {
+  auto* p = static_cast<Pipeline*>(handle);
+  if (!p) return -1;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_consume.wait(lk, [&] {
+    return !p->queue.empty() || p->produced.load() >= p->n_batches;
+  });
+  if (p->queue.empty()) return 1;
+  Batch b = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_produce.notify_one();
+  lk.unlock();
+  std::memcpy(feat_out, b.feats.data(), b.feats.size() * sizeof(float));
+  std::memcpy(label_out, b.labels.data(), b.labels.size() * sizeof(float));
+  return 0;
+}
+
+void dl4j_pipe_reset(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  p->join_workers();
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->queue.clear();
+  }
+  p->epoch += 1;  // reshuffle differently each epoch
+  p->make_order();
+  p->start_workers(2);
+}
+
+long dl4j_pipe_batches_per_epoch(void* handle) {
+  return static_cast<Pipeline*>(handle)->n_batches;
+}
+
+void dl4j_pipe_destroy(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  p->join_workers();
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,91 @@
+"""Profiler + async checkpoint tests (SURVEY.md §5 aux subsystems)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import Sgd
+from deeplearning4j_tpu.profiler import OpProfiler, ProfilerConfig, check_numerics
+from deeplearning4j_tpu.util.checkpoints import (
+    AsyncCheckpointListener, TrainingCheckpointer,
+)
+
+
+def _model(seed=11):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestOpProfiler:
+    def test_sections_and_summary(self):
+        prof = OpProfiler()
+        with prof.section("a"):
+            sum(range(1000))
+        with prof.section("a"):
+            sum(range(1000))
+        with prof.section("b"):
+            pass
+        assert prof.stats("a")["count"] == 2
+        s = prof.summary()
+        assert "a" in s and "b" in s
+
+    def test_time_fn_and_nan_check(self):
+        prof = OpProfiler(ProfilerConfig(check_for_nan=True))
+        out = prof.time_fn("ok", lambda: jnp.ones(3))
+        np.testing.assert_array_equal(np.asarray(out), 1.0)
+        with pytest.raises(FloatingPointError, match="NaN"):
+            prof.time_fn("bad", lambda: jnp.full(3, jnp.nan))
+
+    def test_check_numerics_tree(self):
+        good = {"w": jnp.ones(2), "b": jnp.zeros(1)}
+        check_numerics(good)
+        with pytest.raises(FloatingPointError, match="Inf"):
+            check_numerics({"w": jnp.asarray([1.0, jnp.inf])})
+
+
+class TestCheckpointer:
+    def test_save_restore_roundtrip(self, tmp_path, rng):
+        model = _model()
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        ckpt = TrainingCheckpointer(tmp_path / "ck", keep_last=2,
+                                    async_save=False)
+        for i in range(1, 6):
+            model.fit_batch((x, y))
+            ckpt.save(i, model)
+        ckpt.wait()
+        assert ckpt.all_steps() == [4, 5]  # keep-last-2 retention
+
+        saved_w = np.asarray(model.params[0]["W"]).copy()
+        # train further, then roll back
+        for _ in range(3):
+            model.fit_batch((x, y))
+        assert not np.allclose(saved_w, np.asarray(model.params[0]["W"]))
+        step = ckpt.restore_latest(model)
+        assert step == 5
+        np.testing.assert_allclose(saved_w, np.asarray(model.params[0]["W"]),
+                                   rtol=1e-6)
+        # training continues from the restored state
+        loss = model.fit_batch((x, y))
+        assert np.isfinite(loss)
+        ckpt.close()
+
+    def test_listener_integration(self, tmp_path, rng):
+        model = _model()
+        lst = AsyncCheckpointListener(tmp_path / "ck2",
+                                      save_every_n_iterations=2, keep_last=2)
+        model.set_listeners(lst)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        model.fit(x, y, epochs=7)
+        lst.checkpointer.wait()
+        steps = lst.checkpointer.all_steps()
+        assert steps == [4, 6]
+        lst.checkpointer.close()
