@@ -92,7 +92,7 @@ def grad_check_model(model, x, y, mask=None, **kw) -> dict:
     def loss_of(*args):
         leaf_args, xa, ya = args[:-2], args[-2], args[-1]
         p = jax.tree_util.tree_unflatten(treedef, list(leaf_args))
-        loss, _ = model._loss_terms(p, model.state, xa, ya, None, mask)
+        loss, _, _ = model._loss_terms(p, model.state, xa, ya, None, mask)
         return loss
 
     # x/y passed as trailing args so grad_check casts them to f64 too;

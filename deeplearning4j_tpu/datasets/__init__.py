@@ -13,10 +13,12 @@ from deeplearning4j_tpu.datasets.iterators import (
 from deeplearning4j_tpu.datasets.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
 )
-from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import EmnistDataSetIterator, MnistDataSetIterator
+from deeplearning4j_tpu.datasets.cifar import Cifar10DataSetIterator, SvhnDataSetIterator
 
 __all__ = [
     "DataSet", "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
     "AsyncPrefetchIterator", "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler", "MnistDataSetIterator",
+    "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator",
 ]

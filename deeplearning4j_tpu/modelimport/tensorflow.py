@@ -83,6 +83,9 @@ def _parse_shape(buf: bytes) -> List[int]:
 
 
 def _parse_tensor(buf: bytes) -> np.ndarray:
+    """TensorProto per TF's tensor.proto field numbering:
+    dtype=1, tensor_shape=2, tensor_content=4, float_val=5, double_val=6,
+    int_val=7, string_val=8, int64_val=10, bool_val=11."""
     f = parse_message(buf)
     dtype_enum = f.get(1, [1])[0]
     dtype = _DTYPES.get(dtype_enum, np.float32)
@@ -90,30 +93,39 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
     if 4 in f and f[4][0]:  # tensor_content: raw bytes
         arr = np.frombuffer(f[4][0], dtype=dtype)
         return arr.reshape(shape) if shape else arr
-    # repeated scalar fields (packed or not)
-    for field, dt, fmt in ((5, np.float32, "<f"), (6, np.int32, None),
-                           (9, np.int64, None), (8, np.float64, "<d")):
+
+    def fixed_vals(raws, fmt, width):
+        # a raw entry is either one unpacked fixed value (wire type 5/1,
+        # `width` bytes) or a packed run (wire type 2) — both decode as a
+        # stream of `width`-byte values
+        out = []
+        for raw in raws:
+            out.extend(struct.unpack(fmt, raw[i:i + width])[0]
+                       for i in range(0, len(raw), width))
+        return out
+
+    def varint_vals(raws):
+        out = []
+        for raw in raws:
+            if isinstance(raw, int):           # unpacked varint
+                out.append(_zigzag_ok_int64(raw))
+            else:                               # packed varint run
+                pos = 0
+                while pos < len(raw):
+                    v, pos = _read_varint(raw, pos)
+                    out.append(_zigzag_ok_int64(v))
+        return out
+
+    for field, dt, decode in (
+            (5, np.float32, lambda r: fixed_vals(r, "<f", 4)),
+            (6, np.float64, lambda r: fixed_vals(r, "<d", 8)),
+            (7, np.int32, varint_vals),
+            (10, np.int64, varint_vals),
+            (11, bool, varint_vals)):
         if field in f:
-            vals = []
-            for raw in f[field]:
-                if isinstance(raw, int):       # unpacked varint
-                    vals.append(_zigzag_ok_int64(raw))
-                elif fmt and len(raw) in (4, 8) and field in (5, 8):
-                    vals.append(struct.unpack(fmt, raw)[0])
-                else:                           # packed buffer
-                    if field in (6, 9):
-                        pos = 0
-                        while pos < len(raw):
-                            v, pos = _read_varint(raw, pos)
-                            vals.append(_zigzag_ok_int64(v))
-                    else:
-                        step = 4 if field == 5 else 8
-                        vals.extend(
-                            struct.unpack(fmt, raw[i:i + step])[0]
-                            for i in range(0, len(raw), step))
-            arr = np.asarray(vals, dtype=dt)
+            arr = np.asarray(decode(f[field]), dtype=dt)
             n = int(np.prod(shape)) if shape else len(arr)
-            if len(arr) == 1 and n > 1:  # splat
+            if len(arr) == 1 and n > 1:  # single-value splat convention
                 arr = np.full(n, arr[0], dt)
             return arr.reshape(shape) if shape else arr
     return np.zeros(shape, dtype)

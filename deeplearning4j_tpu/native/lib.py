@@ -64,15 +64,20 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def load_native_lib() -> Optional[ctypes.CDLL]:
-    """Build (if needed) and load the native library; None if unavailable."""
+    """Build (if needed) and load the native library; None if unavailable.
+    One attempt per process — success and failure are both cached."""
     global _lib, _tried
     with _lock:
-        if _lib is not None or _tried and not _SO.exists():
+        if _tried:
             return _lib
         _tried = True
-        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
-            if not _build():
-                return None
+        stale = (_SRC.exists()
+                 and (not _SO.exists()
+                      or _SO.stat().st_mtime < _SRC.stat().st_mtime))
+        if stale and not _build():
+            return None
+        if not _SO.exists():
+            return None
         try:
             _lib = _declare(ctypes.CDLL(str(_SO)))
         except OSError:

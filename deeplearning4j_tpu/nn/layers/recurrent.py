@@ -78,6 +78,14 @@ class LSTMLayer(Layer):
                                       peephole=params.get("pW"))
         return (h, c), ys[:, 0]
 
+    def apply_with_carry(self, params, x, carry, *, mask=None):
+        """Sequence forward from an explicit carry (tBPTT / stored-state).
+        Returns (outputs [B,T,H], new_carry)."""
+        ys, (h, c) = op("lstm_layer")(x, carry[0], carry[1], params["W"],
+                                      params["RW"], params["b"],
+                                      peephole=params.get("pW"))
+        return _mask_outputs(ys, mask), (h, c)
+
     def initial_carry(self, batch, dtype=jnp.float32):
         return (jnp.zeros((batch, self.n_out), dtype), jnp.zeros((batch, self.n_out), dtype))
 
@@ -120,6 +128,14 @@ class GRULayer(Layer):
         ys, _ = op("gru_layer")(x, h0, params["W"], params["RW"], params["b"])
         return _mask_outputs(ys, mask), state
 
+    def apply_with_carry(self, params, x, carry, *, mask=None):
+        ys, hT = op("gru_layer")(x, carry[0], params["W"], params["RW"],
+                                 params["b"])
+        return _mask_outputs(ys, mask), (hT,)
+
+    def initial_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
 
 @register_layer
 @dataclasses.dataclass(frozen=True, kw_only=True)
@@ -150,6 +166,15 @@ class SimpleRnnLayer(Layer):
         ys, _ = op("simple_rnn_layer")(x, h0, params["W"], params["RW"], params["b"],
                                        activation=act)
         return _mask_outputs(ys, mask), state
+
+    def apply_with_carry(self, params, x, carry, *, mask=None):
+        act = resolve_activation(self.activation)
+        ys, hT = op("simple_rnn_layer")(x, carry[0], params["W"], params["RW"],
+                                        params["b"], activation=act)
+        return _mask_outputs(ys, mask), (hT,)
+
+    def initial_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),)
 
 
 @register_layer

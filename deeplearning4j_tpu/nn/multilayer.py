@@ -157,8 +157,17 @@ class MultiLayerNetwork:
         return fn(self.params, self.state, x)
 
     # ------------------------------------------------------------------- fit
-    def _loss_terms(self, params, state, x, y, rng, mask):
-        preout, new_states, out_mask, features = self._forward(params, state, x, True, rng, mask)
+    def _loss_terms(self, params, state, x, y, rng, mask, carries=None):
+        """Loss + aux from one forward. With ``carries`` (tBPTT) the RNN
+        layers start from explicit carried state; returns
+        (loss, new_states, new_carries-or-None)."""
+        if carries is None:
+            preout, new_states, out_mask, features = self._forward(
+                params, state, x, True, rng, mask)
+            new_carries = None
+        else:
+            preout, new_states, out_mask, features, new_carries = (
+                self._forward_carry(params, state, x, carries, True, rng, mask))
         out_layer = self.layers[-1]
         per = out_layer.score_from_preout(y, preout, out_mask)
         if isinstance(out_layer, CenterLossOutputLayer):
@@ -172,36 +181,171 @@ class MultiLayerNetwork:
         else:
             loss = per.mean()
         reg = sum(l.regularization(p) for l, p in zip(self.layers, params))
-        return loss + reg, new_states
+        return loss + reg, new_states, new_carries
+
+    def _apply_updaters(self, grads, params, opt_state, step):
+        if self.conf.max_grad_norm > 0:
+            grads = global_norm_clip(grads, self.conf.max_grad_norm)
+        new_params, new_opt = [], []
+        for i, u in enumerate(self._updaters):
+            upd, ost = u.update(grads[i], opt_state[i], params[i], step)
+            new_params.append(jax.tree_util.tree_map(lambda p, d: p - d,
+                                                     params[i], upd))
+            new_opt.append(ost)
+        return new_params, new_opt
 
     def _make_train_step(self):
-        updaters = self._updaters
-        max_norm = self.conf.max_grad_norm
-
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, opt_state, step, x, y, key, mask):
             def loss_fn(p):
                 cp = _tree_cast(p, self._policy.compute_dtype)
                 cx = x if not jnp.issubdtype(x.dtype, jnp.floating) else x.astype(
                     self._policy.compute_dtype)
-                loss, new_states = self._loss_terms(cp, state, cx, y, key, mask)
+                loss, new_states, _ = self._loss_terms(cp, state, cx, y, key, mask)
                 return loss.astype(jnp.float32), new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            if max_norm > 0:
-                grads = global_norm_clip(grads, max_norm)
-            new_params, new_opt = [], []
-            for i, u in enumerate(updaters):
-                upd, ost = u.update(grads[i], opt_state[i], params[i], step)
-                new_params.append(jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd))
-                new_opt.append(ost)
+            new_params, new_opt = self._apply_updaters(grads, params, opt_state, step)
             return new_params, new_states, new_opt, loss
 
         return train_step
 
+    # ------------------------------------------------------------- tBPTT
+    def _forward_carry(self, params, state, x, carries, train, rng, mask):
+        """_forward variant threading explicit RNN carries (tBPTT /
+        rnnTimeStep). carries: {layer_idx: carry_tuple}; returns
+        (preout, new_states, mask, features, new_carries)."""
+        new_states, new_carries = [], {}
+        itype_chain = self.conf.layer_input_types
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i](x)
+            k = jax.random.fold_in(rng, i) if rng is not None else None
+            if i == n - 1 and hasattr(layer, "preout"):
+                x = layer._maybe_dropout(x, train, k) if train else x
+                new_states.append(state[i])
+                return layer.preout(params[i], x), new_states, mask, x, new_carries
+            if i in carries and hasattr(layer, "apply_with_carry"):
+                x = layer._maybe_dropout(x, train, k) if train else x
+                x, new_carries[i] = layer.apply_with_carry(params[i], x,
+                                                           carries[i], mask=mask)
+                new_states.append(state[i])
+            else:
+                x, s = layer.apply(params[i], state[i], x, train=train, rng=k,
+                                   mask=mask)
+                new_states.append(s)
+            mask = layer.feed_forward_mask(mask, itype_chain[i])
+        return x, new_states, mask, x, new_carries
+
+    def _rnn_layer_indices(self):
+        return [i for i, l in enumerate(self.layers)
+                if hasattr(l, "apply_with_carry")]
+
+    def _init_carries(self, batch: int):
+        return {i: self.layers[i].initial_carry(batch)
+                for i in self._rnn_layer_indices()}
+
+    def _make_tbptt_step(self):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, state, opt_state, step_i, x, y, key, mask, carries):
+            def loss_fn(p):
+                cp = _tree_cast(p, self._policy.compute_dtype)
+                cx = x if not jnp.issubdtype(x.dtype, jnp.floating) else x.astype(
+                    self._policy.compute_dtype)
+                loss, new_states, new_carries = self._loss_terms(
+                    cp, state, cx, y, key, mask, carries=carries)
+                return loss.astype(jnp.float32), (new_states, new_carries)
+
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = self._apply_updaters(grads, params,
+                                                       opt_state, step_i)
+            # gradients do NOT flow across chunk boundaries (truncated BPTT)
+            new_carries = jax.lax.stop_gradient(new_carries)
+            return new_params, new_states, new_opt, loss, new_carries
+
+        return step
+
+    def _fit_tbptt(self, x, y, mask) -> float:
+        L = self.conf.tbptt_fwd_length
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        T = x.shape[1]
+        step_fn = self._jit_cache.get("tbptt")
+        if step_fn is None:
+            step_fn = self._make_tbptt_step()
+            self._jit_cache["tbptt"] = step_fn
+        carries = self._init_carries(x.shape[0])
+        total, n_chunks = 0.0, 0
+        # full chunks, then the trailing partial chunk (its different shape
+        # compiles once and is cached like any other jit specialization)
+        starts = list(range(0, (T // L) * L, L))
+        if T % L:
+            starts.append((T // L) * L)
+        for s in starts:
+            xc, yc = x[:, s:s + L], y[:, s:s + L]
+            mc = None if mask is None else jnp.asarray(mask)[:, s:s + L]
+            key = self._next_key()
+            self.params, self.state, self.opt_state, loss, carries = step_fn(
+                self.params, self.state, self.opt_state,
+                jnp.asarray(self.step_count, jnp.int32), xc, yc, key, mc,
+                carries)
+            total += float(loss)
+            n_chunks += 1
+        self.score_value = total / max(n_chunks, 1)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.step_count, self.epoch_count,
+                               self.score_value)
+        self.step_count += 1
+        return self.score_value
+
+    # ---------------------------------------------------- stored-state RNN
+    def rnn_time_step(self, x):
+        """Streaming inference with persisted RNN state
+        (MultiLayerNetwork.rnnTimeStep). x [B, T, F] or [B, F] (single step).
+        Output activations for the new timesteps; state persists across calls
+        until rnn_clear_previous_state()."""
+        x = jnp.asarray(x)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is None:
+            carries = self._init_carries(x.shape[0])
+        fn = self._jit_cache.get("rnn_time_step")
+        if fn is None:
+            @jax.jit
+            def fn(params, state, x, carries):
+                cp = _tree_cast(params, self._policy.compute_dtype)
+                preout, _, _, _, new_carries = self._forward_carry(
+                    cp, state, x, carries, False, None, None)
+                out_layer = self.layers[-1]
+                if hasattr(out_layer, "preout"):
+                    from deeplearning4j_tpu.nn.layers.base import resolve_activation
+
+                    out = resolve_activation(out_layer.activation)(preout)
+                else:
+                    out = preout
+                return out.astype(self._policy.output_dtype), new_carries
+
+            self._jit_cache["rnn_time_step"] = fn
+        out, new_carries = fn(self.params, self.state, x, carries)
+        # layers without an entry in new_carries keep their previous carry
+        merged = dict(carries)
+        merged.update(new_carries)
+        self._rnn_carries = merged
+        return out[:, 0] if single else out
+
+    def rnn_clear_previous_state(self):
+        """MultiLayerNetwork.rnnClearPreviousState analog."""
+        self._rnn_carries = None
+
     def fit_batch(self, ds) -> float:
         """One optimization step on a DataSet/(features, labels) pair."""
         x, y, mask = _unpack(ds)
+        if (self.conf.tbptt_fwd_length > 0 and np.ndim(x) == 3
+                and np.shape(x)[1] > self.conf.tbptt_fwd_length):
+            return self._fit_tbptt(x, y, mask)
         step_fn = self._jit_cache.get("train")
         if step_fn is None:
             step_fn = self._make_train_step()

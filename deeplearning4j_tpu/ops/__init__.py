@@ -16,5 +16,6 @@ from deeplearning4j_tpu.ops.registry import (
     register_op,
 )
 from deeplearning4j_tpu.ops import activations, losses  # noqa: F401  (populate registries)
+from deeplearning4j_tpu.ops import pallas  # noqa: F401  (register accelerated kernels)
 
 __all__ = ["OpImpl", "get_op", "op", "register_impl", "register_op"]

@@ -1,0 +1,14 @@
+"""Pallas (Mosaic) TPU kernels — the cuDNN-helper tier.
+
+Reference analog: deeplearning4j-cuda's LayerHelper kernels
+(CudnnConvolutionHelper, CudnnLSTMHelper, ...) and libnd4j's platform
+helpers (libnd4j/include/ops/declarable/platform/cudnn/). Each kernel here
+registers over a named op in the registry via register_impl with an
+applicability predicate — the runtime-selection seam SURVEY.md §2.1 calls
+for. Importing this package performs the registration.
+"""
+
+from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
+from deeplearning4j_tpu.ops.pallas.fused_lstm import fused_lstm_layer
+
+__all__ = ["flash_attention", "fused_lstm_layer"]

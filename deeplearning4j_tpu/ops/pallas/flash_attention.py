@@ -1,0 +1,158 @@
+"""Flash attention — blocked online-softmax Pallas kernel.
+
+Reference analog: the role cuDNN's fused multi-head attention plays for the
+reference's SelfAttentionLayer (deeplearning4j-cuda LayerHelper tier); the
+algorithm is FlashAttention-style blocking: the [Tq, Tk] score matrix is
+never materialized in HBM — each (batch*head, q-block) program streams
+k/v-blocks through VMEM maintaining running max/denominator, so HBM traffic
+is O(T*D) instead of O(T^2).
+
+Grid: (B*H, Tq/bq, Tk/bk) with the k-axis innermost; m/l/acc scratch
+persists across the k iterations of one q-block (TPU grids execute the
+minor-most dimension sequentially). Registered over "dot_product_attention"
+for long unmasked sequences; the backward pass recomputes attention via the
+XLA lowering (memory-optimal fwd, standard bwd).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops.registry import register_impl
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal, scale, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [bq, bk]
+    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+    # mask the ragged tail block (out-of-bounds key columns read padding)
+    s = jnp.where(kpos < seq_k, s, -jnp.inf)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 0)
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+
+    m_prev = m_scr[:]                                  # [bq, 1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    # all-masked rows keep m=-inf; guard the exp
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
+    v = v_ref[0].astype(jnp.float32)
+    # zero padded tail rows of v: 0-weight x NaN-padding would poison the dot
+    vrow = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+    v = jnp.where(vrow < seq_k, v, 0.0)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[:] /
+                    jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+    grid = (B * H, pl.cdiv(Tq, bq), pl.cdiv(Tk, bk))
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk, seq_k=Tk),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    # recompute-standard backward: memory already saved on the forward; the
+    # bwd uses XLA's fused softmax-attention gradient
+    q, k, v = res
+
+    def ref(q, k, v):
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, scale=scale, causal=causal)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, mask=None, scale=None, causal=False,
+                    block_q: int = 128, block_k: int = 128):
+    """Public entry: same signature as the XLA dot_product_attention."""
+    if mask is not None:
+        raise ValueError("flash_attention kernel handles mask=None only "
+                         "(causal flag supported); registry predicate "
+                         "routes masked calls to the XLA lowering")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, causal, float(scale), block_q, block_k)
+
+
+def _flash_applicable(q, k, v, *, mask=None, scale=None, causal=False, **kw):
+    # long-sequence, unmasked, head_dim lane-aligned
+    return (mask is None and q.shape[-2] >= 512 and q.shape[-1] % 128 == 0
+            and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0)
+
+
+register_impl("dot_product_attention", platform="pallas",
+              predicate=_flash_applicable, priority=1)(flash_attention)

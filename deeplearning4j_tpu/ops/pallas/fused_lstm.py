@@ -1,0 +1,116 @@
+"""Fused LSTM recurrence — single-kernel sequence loop.
+
+Reference analog: CudnnLSTMHelper (deeplearning4j-cuda ::
+org.deeplearning4j.nn.layers.recurrent.CudnnLSTMHelper), which replaces the
+per-timestep Java loop with one cuDNN persistent-RNN launch. Same split
+here: the [B*T, F]x[F,4H] input projection is left to XLA (it is a single
+MXU-shaped matmul); the irreducibly-sequential part — T iterations of
+h@R + gate elementwise — runs inside ONE Pallas kernel with h/c resident in
+VMEM scratch and R pinned in VMEM, so the recurrence never round-trips HBM
+per step (the reason cuDNN's persistent kernels win).
+
+Grid: (T,) sequential; xg block [B, 4H] per step; gate order IFOG matching
+ops/recurrent.lstm_layer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops.registry import register_impl
+
+
+def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, out_ref, hT_ref, cT_ref,
+                 h_scr, c_scr, *, hidden):
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+    H = hidden
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    g = xg_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+        h_scr[:], r_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [B, 4H]
+    i = jax.nn.sigmoid(g[:, :H])
+    f = jax.nn.sigmoid(g[:, H:2 * H])
+    o = jax.nn.sigmoid(g[:, 2 * H:3 * H])
+    z = jnp.tanh(g[:, 3 * H:])
+    c_new = f * c_scr[:] + i * z
+    h_new = o * jnp.tanh(c_new)
+    c_scr[:] = c_new
+    h_scr[:] = h_new
+    out_ref[0] = h_new.astype(out_ref.dtype)
+
+    @pl.when(t == nt - 1)
+    def _final():
+        hT_ref[:] = h_new.astype(hT_ref.dtype)
+        cT_ref[:] = c_new.astype(cT_ref.dtype)
+
+
+def _fused_recurrence(xg, R, h0, c0, *, interpret):
+    """xg [T, B, 4H] time-major pre-projected gates; returns
+    (outputs [T, B, H], hT, cT)."""
+    T, B, G = xg.shape
+    H = G // 4
+    out, hT, cT = pl.pallas_call(
+        functools.partial(_lstm_kernel, hidden=H),
+        out_shape=(jax.ShapeDtypeStruct((T, B, H), xg.dtype),
+                   jax.ShapeDtypeStruct((B, H), xg.dtype),
+                   jax.ShapeDtypeStruct((B, H), xg.dtype)),
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, G), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, G), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xg, R, h0, c0)
+    return out, hT, cT
+
+
+def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
+                     forget_gate_bias=0.0, reverse=False):
+    """Drop-in accelerated impl of the "lstm_layer" op (same signature)."""
+    H = R.shape[0]
+    xg = x @ W + b
+    if forget_gate_bias:
+        xg = xg.at[..., H:2 * H].add(forget_gate_bias)
+    xg = jnp.swapaxes(xg, 0, 1)  # [T, B, 4H]
+    if reverse:
+        xg = jnp.flip(xg, axis=0)
+    interpret = jax.default_backend() != "tpu"
+    out, hT, cT = _fused_recurrence(xg, R, h0, c0, interpret=interpret)
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return jnp.swapaxes(out, 0, 1), (hT, cT)
+
+
+def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
+    # peepholes (GravesLSTM) stay on the scan path; kernel wants lane-aligned
+    # hidden size and a batch that fits a VMEM tile
+    H = R.shape[0]
+    return (peephole is None and H % 128 == 0 and x.shape[0] % 8 == 0)
+
+
+register_impl("lstm_layer", platform="pallas", predicate=_lstm_applicable,
+              priority=1)(fused_lstm_layer)

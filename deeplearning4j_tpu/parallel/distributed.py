@@ -1,0 +1,94 @@
+"""Multi-host distribution + fault tolerance.
+
+Reference analog (SURVEY.md §2.4, §5): the Spark TrainingMaster / Aeron
+VoidParameterServer stack — worker membership, heartbeat/mesh repair
+(MeshOrganizer), RDD-lineage retry. TPU-native, the transport disappears
+entirely: jax.distributed + XLA collectives over ICI/DCN own communication,
+so what remains of "fault tolerance" is (a) coordinated multi-host init from
+environment and (b) checkpoint-based restart — a crashed job relaunches,
+re-initializes, restores the latest step, and continues (the elastic story
+the reference implements with Spark retries).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import jax
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> dict:
+    """jax.distributed.initialize wrapper, env-driven like the reference's
+    VoidParameterServer config (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID;
+    on TPU pods the args auto-detect from the metadata server).
+
+    Returns a summary dict; a no-op single-process summary when no
+    coordinator is configured.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+class FaultTolerantTrainer:
+    """Checkpoint-restart training loop.
+
+    Wraps any model exposing fit_batch/params with a TrainingCheckpointer:
+    on construction it restores the newest checkpoint if one exists (the
+    relaunch path), and during training it saves every ``save_every`` steps.
+    A crash at any point loses at most ``save_every`` steps — the same
+    guarantee the reference gets from Spark's retry + param-averaging
+    master, without a parameter server.
+
+        trainer = FaultTolerantTrainer(model, ckpt_dir, save_every=50)
+        trainer.fit(iterator, epochs=3)    # safe to kill + rerun
+    """
+
+    def __init__(self, model, checkpoint_dir: str, save_every: int = 100,
+                 keep_last: int = 3, on_restore: Optional[Callable] = None):
+        from deeplearning4j_tpu.util.checkpoints import TrainingCheckpointer
+
+        self.model = model
+        self.save_every = max(1, save_every)
+        self.checkpointer = TrainingCheckpointer(checkpoint_dir,
+                                                 keep_last=keep_last)
+        self.restored_step = self.checkpointer.restore_latest(model)
+        if self.restored_step is not None and on_restore:
+            on_restore(self.restored_step)
+
+    def fit_batch(self, ds) -> float:
+        loss = self.model.fit_batch(ds)
+        step = self.model.step_count
+        if step % self.save_every == 0:
+            self.checkpointer.save(step, self.model)
+        return loss
+
+    def fit(self, data, epochs: int = 1):
+        for _ in range(epochs):
+            for ds in data:
+                self.fit_batch(ds)
+            if hasattr(data, "reset"):
+                data.reset()
+            self.model.epoch_count += 1
+        self.checkpointer.save(self.model.step_count, self.model)
+        self.checkpointer.wait()
+        return self.model
+
+    def close(self):
+        self.checkpointer.close()

@@ -32,6 +32,10 @@ struct Workspace {
   size_t peak;       // high-water mark across resets (used for spill stats)
   size_t spilled;    // bytes served by malloc because the arena was full
   std::vector<void*> spill_ptrs;
+  // spills from previous scopes: callers may still hold views into them, so
+  // they are only released at destroy (use-after-reset on arena memory reads
+  // stale-but-valid bytes; freeing spills would be real use-after-free)
+  std::vector<void*> retired_spills;
 };
 
 void* dl4j_ws_create(size_t bytes) {
@@ -69,7 +73,8 @@ void* dl4j_ws_alloc(void* handle, size_t bytes, size_t align) {
 void dl4j_ws_reset(void* handle) {
   auto* ws = static_cast<Workspace*>(handle);
   ws->offset = 0;
-  for (void* p : ws->spill_ptrs) ::operator delete(p);
+  ws->retired_spills.insert(ws->retired_spills.end(), ws->spill_ptrs.begin(),
+                            ws->spill_ptrs.end());
   ws->spill_ptrs.clear();
   ws->spilled = 0;
 }
@@ -89,6 +94,8 @@ size_t dl4j_ws_spilled(void* handle) {
 void dl4j_ws_destroy(void* handle) {
   auto* ws = static_cast<Workspace*>(handle);
   dl4j_ws_reset(handle);
+  for (void* p : ws->retired_spills) ::operator delete(p);
+  ws->retired_spills.clear();
   delete ws;
 }
 
@@ -108,6 +115,7 @@ struct Pipeline {
   bool shuffle;
   unsigned seed;
   int queue_cap;
+  int n_threads;
   unsigned epoch;
 
   std::vector<long> order;
@@ -203,9 +211,10 @@ void* dl4j_pipe_create(const char* feat_path, const char* label_path, long n,
   p->seed = seed;
   p->epoch = 0;
   p->queue_cap = queue_cap > 0 ? queue_cap : 4;
+  p->n_threads = n_threads > 0 ? n_threads : 2;
   p->n_batches = n / batch;  // drop last partial, as the reference iterators do
   p->make_order();
-  p->start_workers(n_threads > 0 ? n_threads : 2);
+  p->start_workers(p->n_threads);
   return p;
 }
 
@@ -236,7 +245,7 @@ void dl4j_pipe_reset(void* handle) {
   }
   p->epoch += 1;  // reshuffle differently each epoch
   p->make_order();
-  p->start_workers(2);
+  p->start_workers(p->n_threads);
 }
 
 long dl4j_pipe_batches_per_epoch(void* handle) {

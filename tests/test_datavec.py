@@ -132,3 +132,39 @@ class TestRecordReaderDataSetIterator:
         model.fit(it, epochs=3)
         ev = model.evaluate(it)
         assert ev.accuracy() > 0.8
+
+
+class TestImageDatasets:
+    def test_cifar_synthetic_learnable(self, rng):
+        from deeplearning4j_tpu.datasets import Cifar10DataSetIterator
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  GlobalPoolingLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.optimize import Adam
+
+        it = Cifar10DataSetIterator(batch_size=64, n_examples=512, seed=1)
+        assert it.synthetic
+        ds = next(iter(it))
+        assert ds.features.shape == (64, 32, 32, 3)
+        assert ds.labels.shape == (64, 10)
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(lr=1e-2))
+                .list()
+                .layer(ConvolutionLayer(n_out=16, kernel=(3, 3),
+                                        strides=(2, 2), activation="relu"))
+                .layer(GlobalPoolingLayer(pooling_type="avg"))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(32, 32, 3)).build())
+        model = MultiLayerNetwork(conf).init()
+        model.fit(it, epochs=10)
+        assert model.evaluate(it).accuracy() > 0.5  # 10-class, chance = 0.1
+
+    def test_svhn_shapes(self):
+        from deeplearning4j_tpu.datasets import SvhnDataSetIterator
+
+        it = SvhnDataSetIterator(batch_size=32, n_examples=64, train=False)
+        batches = list(it)
+        assert batches[0].features.shape == (32, 32, 32, 3)
+        assert sum(b.features.shape[0] for b in batches) == 64

@@ -1,0 +1,117 @@
+"""Pallas kernel parity tests (interpret mode on the CPU mesh).
+
+Reference analog: the cuDNN-vs-generic parity tests (CuDNNGradientChecks,
+TestConvolution) — run the same op with and without the accelerated helper
+and assert allclose. Kernels run in Pallas interpret mode off-TPU, so these
+tests validate kernel logic; Mosaic compilation is exercised on real TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import get_op
+from deeplearning4j_tpu.ops.attention import dot_product_attention
+from deeplearning4j_tpu.ops.pallas import flash_attention, fused_lstm_layer
+from deeplearning4j_tpu.ops.recurrent import lstm_layer
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_xla(self, rng, causal):
+        B, H, T, D = 2, 2, 256, 128
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        out = flash_attention(q, k, v, causal=causal)
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_rectangular_blocks(self, rng):
+        B, H, T, D = 1, 1, 384, 128
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        out = flash_attention(q, k, v, block_q=128, block_k=256)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gradients_flow(self, rng):
+        B, H, T, D = 1, 2, 128, 128
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+
+        g1 = jax.grad(lambda q: flash_attention(q, k, v).sum())(q)
+        g2 = jax.grad(lambda q: dot_product_attention(q, k, v).sum())(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_registry_selection(self, rng, monkeypatch):
+        op = get_op("dot_product_attention")
+        # long aligned unmasked sequence -> pallas impl selected
+        q = jnp.zeros((1, 1, 512, 128), jnp.float32)
+        assert op.select(q, q, q).platform == "pallas"
+        # short sequence -> xla
+        q2 = jnp.zeros((1, 1, 64, 128), jnp.float32)
+        assert op.select(q2, q2, q2).platform == "xla"
+        # masked -> xla
+        assert op.select(q, q, q, mask=jnp.ones((1, 1, 512, 512))).platform == "xla"
+        # kill switch (the remove-deeplearning4j-cuda-from-classpath analog)
+        from deeplearning4j_tpu.common.env import env
+
+        monkeypatch.setattr(env, "disable_pallas", True)
+        assert op.select(q, q, q).platform == "xla"
+
+
+class TestFusedLSTM:
+    def test_matches_scan(self, rng):
+        B, T, F, H = 8, 12, 16, 128
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.zeros((B, H))
+        c0 = jnp.zeros((B, H))
+        W = jnp.asarray(rng.normal(size=(F, 4 * H)).astype(np.float32) * 0.1)
+        R = jnp.asarray(rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.1)
+        b = jnp.asarray(rng.normal(size=(4 * H,)).astype(np.float32) * 0.1)
+
+        out_f, (hT_f, cT_f) = fused_lstm_layer(x, h0, c0, W, R, b)
+        out_r, (hT_r, cT_r) = lstm_layer(x, h0, c0, W, R, b)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(hT_f), np.asarray(hT_r),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(cT_f), np.asarray(cT_r),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_reverse(self, rng):
+        B, T, F, H = 8, 6, 8, 128
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.zeros((B, H))
+        c0 = jnp.zeros((B, H))
+        W = jnp.asarray(rng.normal(size=(F, 4 * H)).astype(np.float32) * 0.1)
+        R = jnp.asarray(rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.1)
+        b = jnp.zeros((4 * H,))
+        out_f, _ = fused_lstm_layer(x, h0, c0, W, R, b, reverse=True)
+        out_r, _ = lstm_layer(x, h0, c0, W, R, b, reverse=True)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_registry_predicate(self):
+        op = get_op("lstm_layer")
+        x = jnp.zeros((8, 4, 16))
+        h0 = c0 = jnp.zeros((8, 128))
+        W = jnp.zeros((16, 512))
+        R = jnp.zeros((128, 512))
+        b = jnp.zeros((512,))
+        assert op.select(x, h0, c0, W, R, b).platform == "pallas"
+        # peephole (GravesLSTM) stays on scan path
+        assert op.select(x, h0, c0, W, R, b,
+                         peephole=jnp.zeros(384)).platform == "xla"
+        # unaligned hidden size -> xla
+        R2 = jnp.zeros((100, 400))
+        assert op.select(x, jnp.zeros((8, 100)), jnp.zeros((8, 100)),
+                         jnp.zeros((16, 400)), R2, jnp.zeros(400)).platform == "xla"

@@ -197,3 +197,38 @@ class TestConvImport:
         imported = TFGraphMapper.import_graph(g)
         with pytest.raises(NotImplementedError, match="SomeExoticOp"):
             imported.output({"x": np.zeros((1,), np.float32)})
+
+
+class TestScalarFieldTensors:
+    """Consts stored via int_val/float_val (TF's small-tensor path) rather
+    than tensor_content — the field numbers follow TF's tensor.proto."""
+
+    def _tensor_scalar_fields(self, field, payload, dtype_enum, shape):
+        out = _int_field(1, dtype_enum)
+        out += _len_field(2, _shape_proto(shape))
+        out += payload
+        return _len_field(1, _len_field(1, b"c") + _len_field(2, b"Const")
+                          + _len_field(5, _len_field(1, b"value")
+                                       + _len_field(2, _len_field(8, out))))
+
+    def test_int_val_unpacked(self):
+        # int_val = field 7, unpacked varints
+        payload = _int_field(7, 3) + _int_field(7, 5)
+        g = self._tensor_scalar_fields(7, payload, 3, [2])
+        arr = TFGraphMapper.import_graph(g).constants["c"]
+        np.testing.assert_array_equal(arr, np.asarray([3, 5], np.int32))
+
+    def test_float_val_packed(self):
+        # float_val = field 5, packed run of two floats (8-byte buffer)
+        packed = struct.pack("<ff", 1.5, -2.25)
+        payload = _len_field(5, packed)
+        g = self._tensor_scalar_fields(5, payload, 1, [2])
+        arr = TFGraphMapper.import_graph(g).constants["c"]
+        np.testing.assert_allclose(arr, [1.5, -2.25])
+
+    def test_single_value_splat(self):
+        # one int_val splatted across a [4] shape
+        payload = _int_field(7, 9)
+        g = self._tensor_scalar_fields(7, payload, 3, [4])
+        arr = TFGraphMapper.import_graph(g).constants["c"]
+        np.testing.assert_array_equal(arr, np.full(4, 9, np.int32))
