@@ -12,11 +12,27 @@ Prints exactly ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 
-def _measure(step_fn, args, loss_index, warmup=2, iters=30):
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: ResNet-50 fwd+bwd compiles run into
+    minutes on tunneled backends; caching makes repeat bench runs start hot."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # older jax without the knobs
+
+
+def _measure(step_fn, args, loss_index, warmup=2, iters=50):
     """Time ``iters`` data-dependent steps, forcing completion with a host
     fetch of the final loss.
 
@@ -37,7 +53,8 @@ def _measure(step_fn, args, loss_index, warmup=2, iters=30):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_ours(batch):
+def make_ours(batch):
+    """Build once; returns measure() -> samples/sec using fresh state."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -57,13 +74,22 @@ def bench_ours(batch):
                              {"output": y}, key, None)
         return p, s, o, i + 1, loss
 
-    args = (model.params, model.state, model.opt_state, jnp.asarray(0, jnp.int32),
-            jnp.asarray(0.0))
-    dt = _measure(one, args, loss_index=4)
-    return batch / dt
+    state0 = (model.params, model.state, model.opt_state)
+
+    def measure():
+        # fresh copies each round: the step donates its buffers
+        args = tuple(jax.tree_util.tree_map(lambda a: a + 0, t) for t in state0) + (
+            jnp.asarray(0, jnp.int32), jnp.asarray(0.0))
+        return batch / _measure(one, args, loss_index=4)
+
+    return measure
 
 
-def bench_flax_reference(batch):
+def bench_ours(batch):
+    return make_ours(batch)()
+
+
+def make_flax_reference(batch):
     """Minimal Flax ResNet-50 train step, same shapes/dtype policy."""
     import flax.linen as nn
     import jax
@@ -127,26 +153,54 @@ def bench_flax_reference(batch):
         updates, opt = tx.update(grads, opt, params)
         return optax.apply_updates(params, updates), bs, opt, i + 1, loss
 
-    dt = _measure(one, (params, batch_stats, opt, jnp.asarray(0), jnp.asarray(0.0)),
-                  loss_index=4)
-    return batch / dt
+    state0 = (params, batch_stats, opt)
+
+    def measure():
+        args = tuple(jax.tree_util.tree_map(lambda a: a + 0, t) for t in state0) + (
+            jnp.asarray(0), jnp.asarray(0.0))
+        return batch / _measure(one, args, loss_index=4)
+
+    return measure
+
+
+def bench_flax_reference(batch):
+    return make_flax_reference(batch)()
 
 
 def main():
+    _enable_compile_cache()
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+
+    def run_rounds(b):
+        # Shared tunneled backends drift +/-30% over minutes; interleave A/B
+        # rounds and report the median throughput and median per-round ratio.
+        ours_fn = make_ours(b)
+        try:
+            flax_fn = make_flax_reference(b)
+        except Exception:
+            flax_fn = None
+        ours_runs, ratios = [], []
+        for _ in range(rounds):
+            o = ours_fn()
+            ours_runs.append(o)
+            if flax_fn is not None:
+                try:
+                    ratios.append(o / flax_fn())
+                except Exception:
+                    flax_fn = None  # keep reporting ours even if ref dies
+        med = sorted(ours_runs)[len(ours_runs) // 2]
+        vs = sorted(ratios)[len(ratios) // 2] if ratios else None
+        return med, vs
+
     try:
-        ours = bench_ours(batch)
-    except Exception as e:  # OOM fallback
+        med, vs = run_rounds(batch)
+    except Exception:  # OOM during compile/execute: retry at half batch
         batch = batch // 2
-        ours = bench_ours(batch)
-    try:
-        ref = bench_flax_reference(batch)
-        vs = ours / ref
-    except Exception:
-        ref, vs = None, None
+        med, vs = run_rounds(batch)
     print(json.dumps({
-        "metric": "ResNet-50 ImageNet train throughput (zoo entrypoint, bf16, batch %d)" % batch,
-        "value": round(ours, 2),
+        "metric": "ResNet-50 ImageNet train throughput (zoo entrypoint, bf16, batch %d, median of %d interleaved rounds)" % (batch, rounds),
+        "value": round(med, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": None if vs is None else round(vs, 4),
     }))

@@ -1,9 +1,13 @@
-"""Model import: Keras h5 and TF graphs.
+"""Model import: Keras h5, TF frozen graphs, ONNX models.
 
 Reference analog: deeplearning4j-modelimport (org.deeplearning4j.nn.
-modelimport.keras.KerasModelImport) and org.nd4j.imports (TFGraphMapper).
+modelimport.keras.KerasModelImport) and org.nd4j.imports (TFGraphMapper +
+the ONNX importer). The TF/ONNX paths share a dependency-free protobuf
+wire-format parser.
 """
 
 from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+from deeplearning4j_tpu.modelimport.onnx import OnnxModelImport
 
-__all__ = ["KerasModelImport"]
+__all__ = ["KerasModelImport", "TFGraphMapper", "OnnxModelImport"]

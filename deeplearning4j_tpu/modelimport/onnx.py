@@ -1,0 +1,364 @@
+"""ONNX model import.
+
+Reference analog: org.nd4j.imports (ONNX side of the SameDiff importers,
+org.nd4j.imports.onnx). Reuses the dependency-free protobuf wire parser from
+modelimport.tensorflow for the ModelProto/GraphProto/NodeProto/TensorProto
+subset, then maps nodes onto jax ops. ONNX convs/pools are NCHW with OIHW
+kernels; the mappers transpose to the framework's NHWC/HWIO layouts at the
+boundary so the compute path stays TPU-friendly.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.tensorflow import _read_varint, parse_message
+
+# ------------------------------------------------------------- ONNX schema
+
+_ONNX_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+                7: np.int64, 9: bool, 10: np.float16, 11: np.float64}
+
+
+def _varints(raws) -> List[int]:
+    out = []
+    for raw in raws:
+        if isinstance(raw, int):
+            out.append(raw)
+        else:
+            pos = 0
+            while pos < len(raw):
+                v, pos = _read_varint(raw, pos)
+                out.append(v)
+    return [v - (1 << 64) if v >= (1 << 63) else v for v in out]
+
+
+def _parse_onnx_tensor(buf: bytes) -> tuple:
+    """TensorProto: dims=1, data_type=2, float_data=4, int32_data=5,
+    int64_data=7, name=8, raw_data=9. Returns (name, ndarray)."""
+    f = parse_message(buf)
+    dims = _varints(f.get(1, []))
+    dtype = _ONNX_DTYPES.get(f.get(2, [1])[0], np.float32)
+    name = f[8][0].decode() if 8 in f else ""
+    if 9 in f and f[9][0]:
+        arr = np.frombuffer(f[9][0], dtype=dtype)
+    elif 4 in f:
+        vals = []
+        for raw in f[4]:
+            if isinstance(raw, bytes):
+                vals.extend(struct.unpack(f"<{len(raw) // 4}f", raw))
+            else:
+                vals.append(raw)
+        arr = np.asarray(vals, np.float32)
+    elif 7 in f:
+        arr = np.asarray(_varints(f[7]), np.int64)
+    elif 5 in f:
+        arr = np.asarray(_varints(f[5]), np.int32)
+    else:
+        arr = np.zeros(dims, dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+class OnnxAttr:
+    """AttributeProto: name=1, f=2 (fixed32 float), i=3, s=4, t=5,
+    floats=7, ints=8."""
+
+    def __init__(self, buf: bytes):
+        f = parse_message(buf)
+        self.name = f[1][0].decode()
+        self.f = struct.unpack("<f", f[2][0])[0] if 2 in f else None
+        self.i = _varints(f[3])[0] if 3 in f else None
+        self.s = f[4][0].decode() if 4 in f else None
+        self.t = _parse_onnx_tensor(f[5][0])[1] if 5 in f else None
+        self.ints = _varints(f.get(8, []))
+
+
+class OnnxNode:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+
+    def __init__(self, buf: bytes):
+        f = parse_message(buf)
+        self.inputs = [b.decode() for b in f.get(1, [])]
+        self.outputs = [b.decode() for b in f.get(2, [])]
+        self.name = f[3][0].decode() if 3 in f else (self.outputs[0]
+                                                     if self.outputs else "")
+        self.op = f[4][0].decode()
+        self.attrs: Dict[str, OnnxAttr] = {}
+        for ab in f.get(5, []):
+            a = OnnxAttr(ab)
+            self.attrs[a.name] = a
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def ints(self, name, default=()):
+        a = self.attrs.get(name)
+        return list(a.ints) if a and a.ints else list(default)
+
+
+# --------------------------------------------------------------- op mapping
+
+ONNX_OP_REGISTRY: Dict[str, Callable] = {}
+
+
+def onnx_op(*names):
+    def deco(fn):
+        for n in names:
+            ONNX_OP_REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+def _auto_pad(node, spatial_kernel, spatial_in=None, strides=None):
+    ap = node.attr("auto_pad")
+    if ap and ap.s == "SAME_UPPER":
+        return "SAME"
+    if ap and ap.s == "SAME_LOWER":
+        # XLA "SAME" puts the odd extra pad at the END (SAME_UPPER); ONNX
+        # SAME_LOWER wants it at the BEGINNING — compute explicit pads
+        if spatial_in is None or strides is None:
+            return "SAME"  # no shape info: upper/lower identical when even
+        pads = []
+        for dim, k, s in zip(spatial_in, spatial_kernel, strides):
+            out = -(-dim // s)
+            total = max((out - 1) * s + k - dim, 0)
+            pads.append((total - total // 2, total // 2))  # extra at start
+        return pads
+    pads = node.ints("pads")
+    if pads and any(pads):
+        n = len(pads) // 2
+        return [(pads[i], pads[i + n]) for i in range(n)]
+    return "VALID"
+
+
+@onnx_op("Add")
+def _add(node, xs):
+    return xs[0] + xs[1]
+
+
+@onnx_op("Sub")
+def _sub(node, xs):
+    return xs[0] - xs[1]
+
+
+@onnx_op("Mul")
+def _mul(node, xs):
+    return xs[0] * xs[1]
+
+
+@onnx_op("Div")
+def _div(node, xs):
+    return xs[0] / xs[1]
+
+
+@onnx_op("MatMul")
+def _matmul(node, xs):
+    return xs[0] @ xs[1]
+
+
+@onnx_op("Gemm")
+def _gemm(node, xs):
+    a, b = xs[0], xs[1]
+    alpha = node.attr("alpha")
+    beta = node.attr("beta")
+    ta, tb = node.attr("transA"), node.attr("transB")
+    if ta and ta.i:
+        a = a.T
+    if tb and tb.i:
+        b = b.T
+    y = (alpha.f if alpha and alpha.f is not None else 1.0) * (a @ b)
+    if len(xs) > 2:
+        y = y + (beta.f if beta and beta.f is not None else 1.0) * xs[2]
+    return y
+
+
+@onnx_op("Relu")
+def _relu(node, xs):
+    return jax.nn.relu(xs[0])
+
+
+@onnx_op("LeakyRelu")
+def _leaky(node, xs):
+    a = node.attr("alpha")
+    return jax.nn.leaky_relu(xs[0], a.f if a and a.f is not None else 0.01)
+
+
+@onnx_op("Sigmoid")
+def _sigmoid(node, xs):
+    return jax.nn.sigmoid(xs[0])
+
+
+@onnx_op("Tanh")
+def _tanh(node, xs):
+    return jnp.tanh(xs[0])
+
+
+@onnx_op("Softmax")
+def _softmax(node, xs):
+    ax = node.attr("axis")
+    return jax.nn.softmax(xs[0], axis=ax.i if ax and ax.i is not None else -1)
+
+
+@onnx_op("Identity", "Dropout")
+def _identity(node, xs):
+    return xs[0]
+
+
+@onnx_op("Flatten")
+def _flatten(node, xs):
+    ax = node.attr("axis")
+    axis = ax.i if ax and ax.i is not None else 1
+    x = xs[0]
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return x.reshape(lead, -1)
+
+
+@onnx_op("Reshape")
+def _reshape(node, xs):
+    # ONNX: a 0 in shape copies the corresponding input dimension
+    # (allowzero=0 default)
+    shape = [int(v) for v in np.asarray(xs[1]).ravel()]
+    shape = [xs[0].shape[i] if d == 0 and i < xs[0].ndim else d
+             for i, d in enumerate(shape)]
+    return xs[0].reshape(shape)
+
+
+@onnx_op("Concat")
+def _concat(node, xs):
+    ax = node.attr("axis")
+    return jnp.concatenate(xs, axis=ax.i if ax else 1)
+
+
+@onnx_op("Transpose")
+def _transpose(node, xs):
+    perm = node.ints("perm")
+    return jnp.transpose(xs[0], perm or None)
+
+
+@onnx_op("Conv")
+def _conv(node, xs):
+    x, w = xs[0], xs[1]  # x NCHW, w OIHW
+    strides = node.ints("strides", (1, 1))
+    group = node.attr("group")
+    pad = _auto_pad(node, w.shape[2:], x.shape[2:], strides)
+    y = jax.lax.conv_general_dilated(
+        x, w, tuple(strides), pad,
+        rhs_dilation=tuple(node.ints("dilations", (1, 1))),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=group.i if group and group.i else 1)
+    if len(xs) > 2:
+        y = y + xs[2].reshape(1, -1, 1, 1)
+    return y
+
+
+@onnx_op("MaxPool")
+def _maxpool(node, xs):
+    k = node.ints("kernel_shape")
+    s = node.ints("strides", k)
+    pad = _auto_pad(node, k, xs[0].shape[2:], s)
+    if isinstance(pad, list):
+        pad = [(0, 0), (0, 0)] + pad
+    return jax.lax.reduce_window(xs[0], -jnp.inf, jax.lax.max,
+                                 (1, 1, *k), (1, 1, *s), pad)
+
+
+@onnx_op("AveragePool")
+def _avgpool(node, xs):
+    k = node.ints("kernel_shape")
+    s = node.ints("strides", k)
+    pad = _auto_pad(node, k, xs[0].shape[2:], s)
+    if isinstance(pad, list):
+        pad = [(0, 0), (0, 0)] + pad
+    y = jax.lax.reduce_window(xs[0], 0.0, jax.lax.add,
+                              (1, 1, *k), (1, 1, *s), pad)
+    cip = node.attr("count_include_pad")
+    if pad == "VALID" or (cip and cip.i):
+        return y / float(np.prod(k))
+    # default count_include_pad=0: divide by the number of NON-pad cells
+    counts = jax.lax.reduce_window(jnp.ones_like(xs[0]), 0.0, jax.lax.add,
+                                   (1, 1, *k), (1, 1, *s), pad)
+    return y / counts
+
+
+@onnx_op("GlobalAveragePool")
+def _gap(node, xs):
+    return xs[0].mean(axis=(2, 3), keepdims=True)
+
+
+@onnx_op("BatchNormalization")
+def _bn(node, xs):
+    x, scale, bias, mean, var = xs[:5]
+    eps = node.attr("epsilon")
+    eps = eps.f if eps and eps.f is not None else 1e-5
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = (scale / jnp.sqrt(var + eps)).reshape(shape)
+    return x * inv + (bias - mean * scale / jnp.sqrt(var + eps)).reshape(shape)
+
+
+# ------------------------------------------------------------- the importer
+
+
+class OnnxImportedGraph:
+    def __init__(self, nodes: List[OnnxNode], initializers: Dict[str, np.ndarray],
+                 inputs: List[str], outputs: List[str]):
+        self.nodes = nodes
+        self.initializers = initializers
+        self.graph_inputs = [i for i in inputs if i not in initializers]
+        self.graph_outputs = outputs
+
+    def output(self, feeds: Dict[str, np.ndarray],
+               outputs: Optional[List[str]] = None):
+        acts: Dict[str, object] = {k: jnp.asarray(v)
+                                   for k, v in self.initializers.items()}
+        for k, v in feeds.items():
+            acts[k] = jnp.asarray(v)
+        for node in self.nodes:
+            fn = ONNX_OP_REGISTRY.get(node.op)
+            if fn is None:
+                raise NotImplementedError(
+                    f"ONNX op '{node.op}' (node {node.name}) has no mapper; "
+                    f"register one with @onnx_op('{node.op}')")
+            xs = [acts[i] for i in node.inputs if i]
+            y = fn(node, xs)
+            outs = node.outputs or [node.name]
+            if isinstance(y, (list, tuple)):
+                for o, v in zip(outs, y):
+                    acts[o] = v
+            else:
+                acts[outs[0]] = y
+        names = outputs or self.graph_outputs
+        res = [acts[n] for n in names]
+        return res[0] if len(res) == 1 else res
+
+    def as_function(self, outputs: Optional[List[str]] = None) -> Callable:
+        def fn(**feeds):
+            return self.output(feeds, outputs)
+
+        return fn
+
+
+class OnnxModelImport:
+    """importModel entry point (the ONNX analog of KerasModelImport)."""
+
+    @staticmethod
+    def import_model(path_or_bytes) -> OnnxImportedGraph:
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            buf = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as f:
+                buf = f.read()
+        model = parse_message(buf)            # ModelProto: graph = 7
+        graph = parse_message(model[7][0])    # GraphProto
+        nodes = [OnnxNode(b) for b in graph.get(1, [])]
+        inits = dict(_parse_onnx_tensor(b) for b in graph.get(5, []))
+        def _value_names(bufs):
+            return [parse_message(b)[1][0].decode() for b in bufs]
+
+        inputs = _value_names(graph.get(11, []))
+        outputs = _value_names(graph.get(12, []))
+        return OnnxImportedGraph(nodes, inits, inputs, outputs)

@@ -28,6 +28,9 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     TimeDistributedLayer,
 )
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.layers.variational import (
+    AutoEncoderLayer, VariationalAutoencoderLayer,
+)
 from deeplearning4j_tpu.nn.layers.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, TransformerEncoderLayer,
 )
@@ -48,5 +51,5 @@ __all__ = [
     "BidirectionalLayer", "GravesBidirectionalLSTMLayer", "LastTimeStepLayer",
     "MaskZeroLayer", "TimeDistributedLayer",
     "SelfAttentionLayer", "LearnedSelfAttentionLayer", "TransformerEncoderLayer",
-    "Yolo2OutputLayer",
+    "Yolo2OutputLayer", "AutoEncoderLayer", "VariationalAutoencoderLayer",
 ]

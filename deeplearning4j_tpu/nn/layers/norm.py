@@ -46,8 +46,14 @@ class BatchNormalizationLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))
         if train and not self.use_mean_var_from_state:
-            mean = x.mean(axes)
-            var = x.var(axes)
+            # one-pass statistics: E[x] and E[x^2] reduce over the same input,
+            # so XLA fuses both into a single read of the activation —
+            # x.var() would cost a second full pass ((x - mean)^2 depends on
+            # the first reduction). f32 accumulation for bf16 activations.
+            xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+            mean = xf.mean(axes)
+            var = (xf * xf).mean(axes) - mean * mean
+            var = jnp.maximum(var, 0.0)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -55,7 +61,12 @@ class BatchNormalizationLayer(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        # normalize in the activation dtype: the stats are f32 (above), but
+        # promoting the elementwise math would make every activation-sized
+        # tensor (and its backward cotangent) f32 — 2x the HBM traffic that
+        # bf16 training is supposed to save
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps)).astype(x.dtype)
+        xhat = (x - mean.astype(x.dtype)) * inv
         if not self.lock_gamma_beta:
             xhat = xhat * params["gamma"] + params["beta"]
         return xhat.astype(x.dtype), new_state

@@ -1,0 +1,182 @@
+"""Autoencoder + variational autoencoder layers (the pretrain tier).
+
+Reference analog: org.deeplearning4j.nn.conf.layers.AutoEncoder (denoising
+autoencoder pretrain layer) and org.deeplearning4j.nn.conf.layers.variational.
+VariationalAutoencoder (+ reconstruction distributions). In the reference
+these layers carry their own encoder/decoder params and are trained
+layerwise via MultiLayerNetwork.pretrain(); supervised forward then uses the
+encoder half only. Same contract here, TPU-first: each layer exposes
+``pretrain_loss`` (reconstruction / ELBO) that the model's jitted
+per-layer pretrain step drives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, resolve_activation
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class AutoEncoderLayer(Layer):
+    """Denoising autoencoder (org.deeplearning4j.nn.conf.layers.AutoEncoder).
+
+    corruption_level: probability of zeroing each input during pretraining
+    (the reference's corruptionLevel); supervised forward = encoder only.
+    """
+
+    n_out: int
+    n_in: Optional[int] = None
+    activation: str = "sigmoid"
+    corruption_level: float = 0.3
+    loss: str = "mse"  # reconstruction loss: mse | xent
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.size
+        k1, k2 = jax.random.split(key)
+        p = {
+            "W": self._w(k1, (nin, self.n_out)),
+            "b": self._b((self.n_out,)),
+            # decoder: tied-weights transpose convention + visible bias
+            "vb": jnp.zeros((nin,)),
+        }
+        return p, {}
+
+    def _encode(self, params, x):
+        return resolve_activation(self.activation)(x @ params["W"] + params["b"])
+
+    def _decode(self, params, h):
+        return resolve_activation(self.activation)(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        return self._encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        """Reconstruction loss on corrupted input (per-batch scalar)."""
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level,
+                                        x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        else:
+            corrupted = x
+        recon = self._decode(params, self._encode(params, corrupted))
+        if self.loss == "xent":
+            eps = 1e-7
+            r = jnp.clip(recon, eps, 1 - eps)
+            return -(x * jnp.log(r) + (1 - x) * jnp.log(1 - r)).sum(-1).mean()
+        return ((recon - x) ** 2).sum(-1).mean()
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True, kw_only=True)
+class VariationalAutoencoderLayer(Layer):
+    """VAE (org.deeplearning4j.nn.conf.layers.variational.VariationalAutoencoder).
+
+    Gaussian posterior q(z|x) = N(mu(x), sigma(x)); pretrain loss is the
+    negative ELBO with a Gaussian (mse-style) or Bernoulli reconstruction
+    distribution. Supervised forward outputs the posterior mean (the
+    reference's behavior after pretraining).
+    """
+
+    n_out: int  # latent size
+    encoder_layer_sizes: tuple = (256,)
+    decoder_layer_sizes: tuple = (256,)
+    n_in: Optional[int] = None
+    activation: str = "relu"
+    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    num_samples: int = 1
+
+    def output_type(self, itype):
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        nin = self.n_in or itype.size
+        keys = iter(jax.random.split(key, 64))
+        p = {"enc": [], "dec": []}
+        prev = nin
+        for i, h in enumerate(self.encoder_layer_sizes):
+            p["enc"].append({"W": self._w(next(keys), (prev, h)),
+                             "b": jnp.zeros((h,))})
+            prev = h
+        p["mu_W"] = self._w(next(keys), (prev, self.n_out))
+        p["mu_b"] = jnp.zeros((self.n_out,))
+        p["lv_W"] = self._w(next(keys), (prev, self.n_out))
+        p["lv_b"] = jnp.zeros((self.n_out,))
+        prev = self.n_out
+        for h in self.decoder_layer_sizes:
+            p["dec"].append({"W": self._w(next(keys), (prev, h)),
+                             "b": jnp.zeros((h,))})
+            prev = h
+        out_mult = 2 if self.reconstruction_distribution == "gaussian" else 1
+        p["out_W"] = self._w(next(keys), (prev, nin * out_mult))
+        p["out_b"] = jnp.zeros((nin * out_mult,))
+        return p, {}
+
+    def _mlp(self, layers, x):
+        act = resolve_activation(self.activation)
+        for l in layers:
+            x = act(x @ l["W"] + l["b"])
+        return x
+
+    def encode(self, params, x):
+        h = self._mlp(params["enc"], x)
+        mu = h @ params["mu_W"] + params["mu_b"]
+        logvar = h @ params["lv_W"] + params["lv_b"]
+        return mu, logvar
+
+    def decode(self, params, z):
+        h = self._mlp(params["dec"], z)
+        return h @ params["out_W"] + params["out_b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        mu, _ = self.encode(params, x)
+        return mu, state
+
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO (reconstruction + KL)."""
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        mu, logvar = self.encode(params, x)
+        kl = 0.5 * (jnp.exp(logvar) + mu ** 2 - 1.0 - logvar).sum(-1)
+        rec = 0.0
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction_distribution == "bernoulli":
+                p = jax.nn.sigmoid(out)
+                p = jnp.clip(p, 1e-7, 1 - 1e-7)
+                rec = rec - (x * jnp.log(p) + (1 - x) * jnp.log(1 - p)).sum(-1)
+            else:
+                xm, xlv = jnp.split(out, 2, axis=-1)
+                rec = rec + 0.5 * (((x - xm) ** 2) * jnp.exp(-xlv)
+                                   + xlv + jnp.log(2 * jnp.pi)).sum(-1)
+        rec = rec / self.num_samples
+        return (rec + kl).mean()
+
+    def reconstruct(self, params, x, rng=None):
+        """Posterior-mean reconstruction (generateAtMeanGivenZ analog)."""
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        mu, _ = self.encode(params, x)
+        out = self.decode(params, mu)
+        if self.reconstruction_distribution == "bernoulli":
+            return jax.nn.sigmoid(out)
+        return jnp.split(out, 2, axis=-1)[0]

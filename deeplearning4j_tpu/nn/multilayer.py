@@ -380,6 +380,78 @@ class MultiLayerNetwork:
             self.epoch_count += 1
         return self
 
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1):
+        """Layerwise unsupervised pretraining (MultiLayerNetwork.pretrain).
+
+        Each layer exposing ``pretrain_loss`` (AutoEncoderLayer,
+        VariationalAutoencoderLayer) is trained greedily on the activations
+        of the (frozen) layers below it; supervised fit afterwards fine-tunes
+        everything."""
+        for i, layer in enumerate(self.layers):
+            if not hasattr(layer, "pretrain_loss"):
+                continue
+            self.pretrain_layer(i, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, layer_index: int, data, epochs: int = 1):
+        """Pretrain one layer (MultiLayerNetwork.pretrainLayer)."""
+        layer = self.layers[layer_index]
+        if not hasattr(layer, "pretrain_loss"):
+            raise ValueError(f"layer {layer_index} has no pretrain objective")
+        updater = self._updaters[layer_index]
+
+        key = ("pretrain", layer_index)
+        if key not in self._jit_cache:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(lparams, opt, step_i, below_params, below_state, x, rng):
+                # forward through frozen layers below
+                h = x
+                for j in range(layer_index):
+                    if j in self.conf.preprocessors:
+                        h = self.conf.preprocessors[j](h)
+                    h, _ = self.layers[j].apply(below_params[j], below_state[j],
+                                                h, train=False)
+                if layer_index in self.conf.preprocessors:
+                    h = self.conf.preprocessors[layer_index](h)
+
+                def loss_fn(p):
+                    return layer.pretrain_loss(p, h, rng)
+
+                loss, grads = jax.value_and_grad(loss_fn)(lparams)
+                upd, opt = updater.update(grads, opt, lparams, step_i)
+                lparams = jax.tree_util.tree_map(lambda p, d: p - d,
+                                                 lparams, upd)
+                return lparams, opt, loss
+
+            self._jit_cache[key] = step
+        step_fn = self._jit_cache[key]
+
+        lparams = self.params[layer_index]
+        opt = updater.init_state(lparams)
+        below_p = self.params[:layer_index]
+        below_s = self.state[:layer_index]
+        loss = float("nan")
+        i = 0
+        if hasattr(data, "shape"):  # numpy/jax array of features
+            for _ in range(epochs):
+                lparams, opt, loss = step_fn(
+                    lparams, opt, jnp.asarray(i, jnp.int32), below_p, below_s,
+                    jnp.asarray(data), self._next_key())
+                i += 1
+        else:  # DataSet iterator / list of batches
+            for _ in range(epochs):
+                for ds in data:
+                    x = ds if hasattr(ds, "shape") else _unpack(ds)[0]
+                    lparams, opt, loss = step_fn(
+                        lparams, opt, jnp.asarray(i, jnp.int32), below_p,
+                        below_s, jnp.asarray(x), self._next_key())
+                    i += 1
+                if hasattr(data, "reset"):
+                    data.reset()
+        self.params[layer_index] = lparams
+        return float(loss)
+
     # ----------------------------------------------------------------- score
     def score(self, ds=None) -> float:
         """Loss on a dataset without updating (MultiLayerNetwork.score(DataSet))."""

@@ -1,0 +1,9 @@
+"""Visualization/embedding tools.
+
+Reference analog: org.deeplearning4j.plot — BarnesHutTsne (t-SNE over a
+VPTree for the Barnes-Hut approximation).
+"""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+__all__ = ["BarnesHutTsne"]

@@ -1,0 +1,91 @@
+"""Model serving over HTTP.
+
+Reference analog: the reference's serving tier — ParallelInference behind a
+REST endpoint (deeplearning4j model server / nearest-neighbors-server
+pattern). Stdlib-only HTTP: POST /predict with JSON {"inputs": [[...]]}
+returns {"outputs": [[...]]}; batching + async execution come from
+ParallelInference underneath, so concurrent requests share device batches.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+
+class ModelServer:
+    """Serve a model's output() via JSON HTTP.
+
+        server = ModelServer(model, port=0).start()
+        ... POST http://host:port/predict {"inputs": [...]}
+        server.stop()
+    """
+
+    def __init__(self, model, port: int = 0, host: str = "127.0.0.1",
+                 batch_limit: int = 32, queue_timeout: float = 30.0):
+        self.model = model
+        self._host, self._port = host, port
+        self._timeout = queue_timeout
+        self._pi = ParallelInference(model, batch_limit=batch_limit)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> "ModelServer":
+        self._pi.start()
+        pi, timeout = self._pi, self._timeout
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):  # noqa: N802
+                if self.path.split("?")[0] != "/predict":
+                    self._reply(404, {"error": "unknown endpoint"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    xs = np.asarray(body["inputs"], np.float32)
+                    queues = [pi.submit(x) for x in xs]
+                    outs = [np.asarray(q.get(timeout=timeout)).tolist()
+                            for q in queues]
+                    self._reply(200, {"outputs": outs})
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    self._reply(400, {"error": str(e)})
+
+            def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] == "/health":
+                    self._reply(200, {"status": "ok"})
+                else:
+                    self._reply(404, {"error": "unknown endpoint"})
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self._pi.stop()

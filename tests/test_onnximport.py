@@ -1,0 +1,131 @@
+"""ONNX import tests — golden fixtures built with a test-side protobuf
+writer (same approach as test_tfimport; no onnx package in the sandbox)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.onnx import OnnxModelImport
+from test_tfimport import _int_field, _len_field, _tag, _varint
+
+
+def onnx_tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7,
+          np.dtype(np.int32): 6}[arr.dtype]
+    out = b"".join(_int_field(1, d) for d in arr.shape)
+    out += _int_field(2, dt)
+    out += _len_field(8, name.encode())
+    out += _len_field(9, arr.tobytes())  # raw_data
+    return out
+
+
+def onnx_attr(name: str, *, f=None, i=None, s=None, ints=None) -> bytes:
+    out = _len_field(1, name.encode())
+    if f is not None:
+        out += _tag(2, 5) + struct.pack("<f", f)
+    if i is not None:
+        out += _int_field(3, i)
+    if s is not None:
+        out += _len_field(4, s.encode())
+    if ints is not None:
+        out += b"".join(_int_field(8, v) for v in ints)
+    return out
+
+
+def onnx_node(op: str, inputs, outputs, *attrs) -> bytes:
+    out = b"".join(_len_field(1, i.encode()) for i in inputs)
+    out += b"".join(_len_field(2, o.encode()) for o in outputs)
+    out += _len_field(4, op.encode())
+    out += b"".join(_len_field(5, a) for a in attrs)
+    return out
+
+
+def onnx_value_info(name: str) -> bytes:
+    return _len_field(1, name.encode())
+
+
+def onnx_model(nodes, initializers, inputs, outputs) -> bytes:
+    g = b"".join(_len_field(1, n) for n in nodes)
+    g += b"".join(_len_field(5, t) for t in initializers)
+    g += b"".join(_len_field(11, onnx_value_info(i)) for i in inputs)
+    g += b"".join(_len_field(12, onnx_value_info(o)) for o in outputs)
+    return _len_field(7, g)  # ModelProto.graph
+
+
+class TestOnnxMLP:
+    def test_gemm_relu_softmax(self, rng):
+        W = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(3,)).astype(np.float32)
+        model = onnx_model(
+            nodes=[
+                onnx_node("Gemm", ["x", "W", "b"], ["h"],
+                          onnx_attr("alpha", f=1.0), onnx_attr("beta", f=1.0)),
+                onnx_node("Relu", ["h"], ["r"]),
+                onnx_node("Softmax", ["r"], ["y"], onnx_attr("axis", i=-1)),
+            ],
+            initializers=[onnx_tensor("W", W), onnx_tensor("b", b)],
+            inputs=["x", "W", "b"], outputs=["y"])
+        g = OnnxModelImport.import_model(model)
+        assert g.graph_inputs == ["x"]
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        out = np.asarray(g.output({"x": x}))
+        h = np.maximum(x @ W + b, 0)
+        e = np.exp(h - h.max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestOnnxConv:
+    def test_conv_bn_pool_gap(self, rng):
+        # NCHW/OIHW, the ONNX-native layout
+        K = rng.normal(size=(4, 2, 3, 3)).astype(np.float32)
+        scale = rng.random(4).astype(np.float32) + 0.5
+        bias = rng.normal(size=4).astype(np.float32)
+        mean = rng.normal(size=4).astype(np.float32)
+        var = rng.random(4).astype(np.float32) + 0.5
+        model = onnx_model(
+            nodes=[
+                onnx_node("Conv", ["x", "K"], ["c"],
+                          onnx_attr("strides", ints=[1, 1]),
+                          onnx_attr("auto_pad", s="SAME_UPPER"),
+                          onnx_attr("kernel_shape", ints=[3, 3])),
+                onnx_node("BatchNormalization",
+                          ["c", "s", "b", "m", "v"], ["bn"],
+                          onnx_attr("epsilon", f=1e-5)),
+                onnx_node("Relu", ["bn"], ["r"]),
+                onnx_node("MaxPool", ["r"], ["p"],
+                          onnx_attr("kernel_shape", ints=[2, 2]),
+                          onnx_attr("strides", ints=[2, 2])),
+                onnx_node("GlobalAveragePool", ["p"], ["g"]),
+                onnx_node("Flatten", ["g"], ["y"], onnx_attr("axis", i=1)),
+            ],
+            initializers=[onnx_tensor("K", K), onnx_tensor("s", scale),
+                          onnx_tensor("b", bias), onnx_tensor("m", mean),
+                          onnx_tensor("v", var)],
+            inputs=["x"], outputs=["y"])
+        g = OnnxModelImport.import_model(model)
+        x = rng.normal(size=(2, 2, 8, 8)).astype(np.float32)
+        out = np.asarray(g.output({"x": x}))
+        assert out.shape == (2, 4)
+
+        import jax
+
+        ref = jax.lax.conv_general_dilated(
+            x, K, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        ref = np.asarray(ref)
+        ref = (ref - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+            var.reshape(1, -1, 1, 1) + 1e-5) * scale.reshape(1, -1, 1, 1) \
+            + bias.reshape(1, -1, 1, 1)
+        ref = np.maximum(ref, 0)
+        ref = ref.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        ref = ref.mean(axis=(2, 3))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_unknown_op(self):
+        model = onnx_model(nodes=[onnx_node("FancyOp", ["x"], ["y"])],
+                           initializers=[], inputs=["x"], outputs=["y"])
+        g = OnnxModelImport.import_model(model)
+        with pytest.raises(NotImplementedError, match="FancyOp"):
+            g.output({"x": np.zeros((1,), np.float32)})

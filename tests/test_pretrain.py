@@ -1,0 +1,102 @@
+"""Layerwise pretraining tests (AutoEncoder / VAE).
+
+Reference analog: MultiLayerNetwork.pretrain/pretrainLayer tests and the
+variational TestVAE suite.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    AutoEncoderLayer, DenseLayer, OutputLayer, VariationalAutoencoderLayer,
+)
+from deeplearning4j_tpu.optimize import Adam
+
+
+def _data(rng, n=256, dim=16):
+    # two gaussian clusters -> reconstructable structure + separable labels
+    half = n // 2
+    x = np.concatenate([rng.normal(0.0, 0.3, (half, dim)),
+                        rng.normal(1.0, 0.3, (n - half, dim))]).astype(np.float32)
+    y = np.concatenate([np.zeros(half, np.int64), np.ones(n - half, np.int64)])
+    perm = rng.permutation(n)
+    return x[perm], np.eye(2, dtype=np.float32)[y[perm]]
+
+
+class TestAutoEncoderPretrain:
+    def test_reconstruction_improves(self, rng):
+        x, y = _data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(lr=1e-2))
+                .list()
+                .layer(AutoEncoderLayer(n_out=8, corruption_level=0.2,
+                                        activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        model = MultiLayerNetwork(conf).init()
+        l0 = model.pretrain_layer(0, x, epochs=1)
+        l1 = model.pretrain_layer(0, x, epochs=30)
+        assert np.isfinite(l1) and l1 < l0
+        # supervised fine-tune on top of pretrained features
+        for _ in range(20):
+            model.fit_batch((x, y))
+        from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+
+        ev = model.evaluate(ArrayDataSetIterator(x, y, batch_size=64))
+        assert ev.accuracy() > 0.9
+
+    def test_pretrain_all_layers(self, rng):
+        x, y = _data(rng)
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(lr=1e-2))
+                .list()
+                .layer(AutoEncoderLayer(n_out=12, activation="tanh"))
+                .layer(AutoEncoderLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        model = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(model.params[0]["W"]).copy()
+        w1 = np.asarray(model.params[1]["W"]).copy()
+        model.pretrain(x, epochs=5)
+        assert not np.allclose(w0, np.asarray(model.params[0]["W"]))
+        assert not np.allclose(w1, np.asarray(model.params[1]["W"]))
+
+
+class TestVAE:
+    def test_elbo_improves_and_reconstructs(self, rng):
+        x, _ = _data(rng, n=256, dim=12)
+        layer = VariationalAutoencoderLayer(
+            n_out=4, encoder_layer_sizes=(32,), decoder_layer_sizes=(32,),
+            reconstruction_distribution="gaussian")
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(lr=3e-3))
+                .list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        model = MultiLayerNetwork(conf).init()
+        l0 = model.pretrain_layer(0, x, epochs=1)
+        l1 = model.pretrain_layer(0, x, epochs=60)
+        assert np.isfinite(l1) and l1 < l0
+        # reconstruction error beats predicting the global mean
+        recon = np.asarray(layer.reconstruct(model.params[0], x))
+        err = ((recon - x) ** 2).mean()
+        base = ((x - x.mean(0)) ** 2).mean()
+        assert err < base, (err, base)
+        # latent output drives the supervised head
+        out = model.output(x[:5])
+        assert out.shape == (5, 2)
+
+    def test_bernoulli_distribution(self, rng):
+        x = (rng.random((128, 10)) > 0.5).astype(np.float32)
+        layer = VariationalAutoencoderLayer(
+            n_out=3, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+            reconstruction_distribution="bernoulli")
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(lr=3e-3))
+                .list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10)).build())
+        model = MultiLayerNetwork(conf).init()
+        loss = model.pretrain_layer(0, x, epochs=10)
+        assert np.isfinite(loss)
+        recon = np.asarray(layer.reconstruct(model.params[0], x))
+        assert recon.min() >= 0.0 and recon.max() <= 1.0

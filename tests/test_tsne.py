@@ -1,0 +1,26 @@
+"""t-SNE tests (BarnesHutTsne analog): cluster structure preserved."""
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import BarnesHutTsne
+
+
+class TestTsne:
+    def test_separates_clusters(self, rng):
+        # three well-separated gaussian clusters in 10-D
+        centers = np.eye(3, 10) * 8.0
+        X = np.concatenate([rng.normal(c, 0.3, (30, 10)) for c in centers])
+        labels = np.repeat(np.arange(3), 30)
+        tsne = BarnesHutTsne(n_components=2, perplexity=10, max_iter=400,
+                             seed=1)
+        Y = tsne.fit_transform(X)
+        assert Y.shape == (90, 2)
+        assert np.isfinite(tsne.kl_divergence_)
+        # mean intra-cluster distance well below inter-cluster distance
+        intra = np.mean([np.linalg.norm(Y[labels == k] -
+                                        Y[labels == k].mean(0), axis=1).mean()
+                         for k in range(3)])
+        cents = np.stack([Y[labels == k].mean(0) for k in range(3)])
+        inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                         for a in range(3) for b in range(a + 1, 3)])
+        assert inter > 3.0 * intra, (intra, inter)

@@ -71,3 +71,35 @@ class TestUIServer:
             assert "Training dashboard" in body and "<svg" in body
         finally:
             server.stop()
+
+
+class TestModelServer:
+    def test_predict_endpoint(self, rng):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.serving import ModelServer
+
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(lr=0.1))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        model = MultiLayerNetwork(conf).init()
+        server = ModelServer(model, port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            health = json.loads(urllib.request.urlopen(
+                f"{url}/health", timeout=10).read())
+            assert health["status"] == "ok"
+            xs = rng.normal(size=(3, 4)).astype(np.float32)
+            req = urllib.request.Request(
+                f"{url}/predict",
+                data=json.dumps({"inputs": xs.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            out = np.asarray(body["outputs"])
+            direct = np.asarray(model.output(xs))
+            np.testing.assert_allclose(out, direct, rtol=1e-4, atol=1e-6)
+        finally:
+            server.stop()
