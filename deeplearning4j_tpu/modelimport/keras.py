@@ -84,12 +84,17 @@ class KerasLayerMapper:
         if cls == "ZeroPadding2D":
             p = cfg["padding"]
             return ZeroPadding2DLayer(pad=tuple(tuple(q) for q in p))
-        if cls == "LSTM":
-            return LSTMLayer(n_out=cfg["units"])
-        if cls == "GRU":
-            return GRULayer(n_out=cfg["units"])
-        if cls == "SimpleRNN":
-            return SimpleRnnLayer(n_out=cfg["units"], activation=act)
+        if cls in ("LSTM", "GRU", "SimpleRNN"):
+            inner = {"LSTM": LSTMLayer(n_out=cfg["units"]),
+                     "GRU": GRULayer(n_out=cfg["units"]),
+                     "SimpleRNN": SimpleRnnLayer(n_out=cfg["units"],
+                                                 activation=act)}[cls]
+            if cfg.get("return_sequences", False):
+                return inner
+            # Keras default return_sequences=False -> last timestep only
+            from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+
+            return LastTimeStepLayer(underlying=inner)
         if cls == "Embedding":
             return EmbeddingSequenceLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"])
         if cls in ("InputLayer",):
@@ -184,11 +189,15 @@ class KerasModelImport:
                      for n in g.attrs.get("weight_names", [])]
             return [np.asarray(g[n]) for n in names]
 
+        from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+
         for li, (layer, kname) in enumerate(zip(model.layers, model._keras_names)):
             ws = arrays_for(kname)
             if not ws:
                 continue
             p = model.params[li]
+            if isinstance(layer, LastTimeStepLayer):
+                layer = layer.underlying  # params delegate to the wrapped RNN
             if isinstance(layer, (DenseLayer,)) and "W" in p:
                 p["W"] = jnp.asarray(ws[0])
                 if layer.has_bias and len(ws) > 1:

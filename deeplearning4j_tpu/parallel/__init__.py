@@ -14,6 +14,14 @@ from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.tensor_parallel import TensorParallel
 from deeplearning4j_tpu.parallel.pipeline import GPipe, pipeline_train_step, stack_stage_params
+from deeplearning4j_tpu.parallel.expert import (
+    init_moe_params, moe_param_specs, place_moe_params, switch_moe,
+)
+from deeplearning4j_tpu.parallel.distributed import (
+    FaultTolerantTrainer, initialize_distributed,
+)
 
 __all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference", "TensorParallel",
-           "GPipe", "pipeline_train_step", "stack_stage_params"]
+           "GPipe", "pipeline_train_step", "stack_stage_params",
+           "init_moe_params", "moe_param_specs", "place_moe_params",
+           "switch_moe", "FaultTolerantTrainer", "initialize_distributed"]

@@ -214,3 +214,50 @@ class TestPipelineParallel:
                                             jnp.asarray(i, jnp.int32), x, y)
                 losses.append(float(l))
         assert losses[-1] < losses[0] * 0.7, losses
+
+
+class TestExpertParallel:
+    def test_moe_matches_reference(self, rng):
+        from deeplearning4j_tpu.parallel import (DeviceMesh, init_moe_params,
+                                                 place_moe_params, switch_moe)
+        from deeplearning4j_tpu.parallel.expert import switch_moe_reference
+
+        mesh = DeviceMesh(data=2, model=4)
+        params = init_moe_params(jax.random.key(0), d_model=16, d_hidden=32,
+                                 n_experts=4)
+        params = place_moe_params(params, mesh)
+        x = rng.normal(size=(64, 16)).astype(np.float32)
+        with mesh.mesh:
+            y, aux = jax.jit(switch_moe)(params, jnp.asarray(x))
+        ref = switch_moe_reference(params, x)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-3, atol=2e-4)
+        assert float(aux) >= 1.0 - 1e-3  # balanced routing lower bound is 1
+
+    def test_moe_trains_with_aux_loss(self, rng):
+        from deeplearning4j_tpu.parallel import (DeviceMesh, init_moe_params,
+                                                 place_moe_params, switch_moe)
+
+        mesh = DeviceMesh(data=2, model=4)
+        params = init_moe_params(jax.random.key(1), d_model=8, d_hidden=16,
+                                 n_experts=4)
+        params = place_moe_params(params, mesh)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        w_target = rng.normal(size=(8, 8)).astype(np.float32)
+        y_target = jnp.asarray(x @ w_target)
+        xj = jnp.asarray(x)
+
+        @jax.jit
+        def step(params):
+            def loss_fn(p):
+                y, aux = switch_moe(p, xj)
+                return ((y + xj - y_target) ** 2).mean() + 0.01 * aux
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return jax.tree_util.tree_map(lambda p, g: p - 0.05 * g,
+                                          params, grads), loss
+
+        with mesh.mesh:
+            losses = []
+            for _ in range(80):
+                params, l = step(params)
+                losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.75, (losses[0], losses[-1])
