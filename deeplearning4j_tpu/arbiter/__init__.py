@@ -9,6 +9,7 @@ termination conditions (SURVEY.md §2.3 "Tooling" / §7 step 8).
 from deeplearning4j_tpu.arbiter.spaces import (
     ContinuousParameterSpace, DiscreteParameterSpace, IntegerParameterSpace,
 )
+from deeplearning4j_tpu.arbiter.spaces_net import MultiLayerSpace
 from deeplearning4j_tpu.arbiter.runner import (
     GridSearchGenerator, MaxCandidatesCondition, MaxTimeCondition,
     OptimizationResult, OptimizationRunner, RandomSearchGenerator,
@@ -16,7 +17,7 @@ from deeplearning4j_tpu.arbiter.runner import (
 
 __all__ = [
     "ContinuousParameterSpace", "DiscreteParameterSpace",
-    "IntegerParameterSpace", "RandomSearchGenerator", "GridSearchGenerator",
+    "IntegerParameterSpace", "MultiLayerSpace", "RandomSearchGenerator", "GridSearchGenerator",
     "OptimizationRunner", "OptimizationResult", "MaxCandidatesCondition",
     "MaxTimeCondition",
 ]

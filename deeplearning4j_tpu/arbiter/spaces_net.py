@@ -1,0 +1,100 @@
+"""Network-config search spaces.
+
+Reference analog: org.deeplearning4j.arbiter.MultiLayerSpace /
+layers.DenseLayerSpace etc. — parameter spaces that *generate
+MultiLayerConfiguration candidates*. Here a LayerSpace is any layer
+dataclass whose fields may be ParameterSpace objects; MultiLayerSpace
+samples every space field and builds a concrete MultiLayerConfiguration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+def _is_space(v) -> bool:
+    return hasattr(v, "sample") and callable(v.sample)
+
+
+def _sample_layer(layer, rng):
+    """Replace every ParameterSpace field of a layer dataclass with a draw."""
+    repl = {}
+    for f in dataclasses.fields(layer):
+        v = getattr(layer, f.name)
+        if _is_space(v):
+            repl[f.name] = v.sample(rng)
+    return dataclasses.replace(layer, **repl) if repl else layer
+
+
+class MultiLayerSpace:
+    """Builder over layer templates with ParameterSpace-valued fields.
+
+        space = (MultiLayerSpace.builder()
+                 .updater_space(lambda rng: Adam(lr=lr_space.sample(rng)))
+                 .add_layer(DenseLayer(n_out=IntegerParameterSpace(8, 64),
+                                       activation="relu"))
+                 .add_layer(OutputLayer(n_out=3, activation="softmax",
+                                        loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(10))
+                 .build())
+        conf = space.sample(rng)   # -> concrete MultiLayerConfiguration
+    """
+
+    def __init__(self, layers, input_type, updater_fn=None, seed: int = 0):
+        self._layers = layers
+        self._input_type = input_type
+        self._updater_fn = updater_fn
+        self._seed = seed
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng(self._seed)
+        b = NeuralNetConfiguration.builder().seed(int(rng.integers(1 << 30)))
+        if self._updater_fn is not None:
+            b = b.updater(self._updater_fn(rng))
+        lb = b.list()
+        for layer in self._layers:
+            lb = lb.layer(_sample_layer(layer, rng))
+        return lb.set_input_type(self._input_type).build()
+
+    def candidate_generator(self, seed: int = 0):
+        """Infinite generator of sampled configs (RandomSearch over the
+        space), pluggable into OptimizationRunner as hyperparams={'conf': c}."""
+        rng = np.random.default_rng(seed)
+        while True:
+            yield {"conf": self.sample(rng)}
+
+    # --------------------------------------------------------------- builder
+    class Builder:
+        def __init__(self):
+            self._layers: List = []
+            self._input_type: Optional[InputType] = None
+            self._updater_fn = None
+
+        def add_layer(self, layer) -> "MultiLayerSpace.Builder":
+            self._layers.append(layer)
+            return self
+
+        def updater_space(self, fn) -> "MultiLayerSpace.Builder":
+            """fn(rng) -> Updater instance (sample learning rates etc.)."""
+            self._updater_fn = fn
+            return self
+
+        def set_input_type(self, itype: InputType) -> "MultiLayerSpace.Builder":
+            self._input_type = itype
+            return self
+
+        def build(self) -> "MultiLayerSpace":
+            if self._input_type is None:
+                raise ValueError("MultiLayerSpace requires an input type")
+            return MultiLayerSpace(self._layers, self._input_type,
+                                   self._updater_fn)
+
+    @staticmethod
+    def builder() -> "MultiLayerSpace.Builder":
+        return MultiLayerSpace.Builder()

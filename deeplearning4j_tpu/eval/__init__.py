@@ -5,11 +5,12 @@ ROCMultiClass / ROCBinary, RegressionEvaluation, EvaluationBinary,
 ConfusionMatrix.
 """
 
-from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix, EvaluationBinary
+from deeplearning4j_tpu.eval.evaluation import (Evaluation, ConfusionMatrix,
+    EvaluationBinary, EvaluationCalibration)
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation
 from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
 
 __all__ = [
-    "Evaluation", "ConfusionMatrix", "EvaluationBinary",
+    "Evaluation", "ConfusionMatrix", "EvaluationBinary", "EvaluationCalibration",
     "RegressionEvaluation", "ROC", "ROCMultiClass",
 ]

@@ -192,3 +192,57 @@ class EvaluationBinary:
     def f1(self, i: int) -> float:
         p, r = self.precision(i), self.recall(i)
         return 2 * p * r / (p + r) if p + r else 0.0
+
+
+class EvaluationCalibration:
+    """Reliability / calibration evaluation
+    (org.nd4j.evaluation.classification.EvaluationCalibration): bins
+    predicted probability for the positive/argmax class against observed
+    accuracy, plus residual histograms."""
+
+    def __init__(self, n_bins: int = 10):
+        self.n_bins = n_bins
+        self._conf_sum = np.zeros(n_bins)
+        self._acc_sum = np.zeros(n_bins)
+        self._counts = np.zeros(n_bins, dtype=np.int64)
+        self._residual_counts = np.zeros(n_bins, dtype=np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        conf = preds.max(axis=-1)
+        correct = (preds.argmax(-1) == labels.argmax(-1)).astype(np.float64)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            conf, correct = conf.reshape(-1)[m], correct.reshape(-1)[m]
+        bins = np.clip((conf * self.n_bins).astype(int), 0, self.n_bins - 1)
+        np.add.at(self._conf_sum, bins, conf)
+        np.add.at(self._acc_sum, bins, correct)
+        np.add.at(self._counts, bins, 1)
+        # residual plot: |label - p| averaged over classes per example
+        resid = np.abs(labels.reshape(-1, labels.shape[-1])
+                       - preds.reshape(-1, preds.shape[-1])).mean(-1)
+        if mask is not None:
+            resid = resid[np.asarray(mask).reshape(-1).astype(bool)]
+        rbins = np.clip((resid * self.n_bins).astype(int), 0, self.n_bins - 1)
+        np.add.at(self._residual_counts, rbins, 1)
+        return self
+
+    def reliability_curve(self):
+        """(mean_confidence[b], accuracy[b], count[b]) per non-empty bin."""
+        nz = self._counts > 0
+        return (self._conf_sum[nz] / self._counts[nz],
+                self._acc_sum[nz] / self._counts[nz], self._counts[nz])
+
+    def residual_plot(self):
+        """Histogram counts of mean-absolute residual |label - p| per
+        example, binned over [0, 1] (getResidualPlot analog)."""
+        edges = np.linspace(0.0, 1.0, self.n_bins + 1)
+        return edges, self._residual_counts.copy()
+
+    def expected_calibration_error(self) -> float:
+        conf, acc, counts = self.reliability_curve()
+        if counts.sum() == 0:
+            return float("nan")
+        w = counts / counts.sum()
+        return float((w * np.abs(conf - acc)).sum())

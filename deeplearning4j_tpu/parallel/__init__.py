@@ -17,6 +17,10 @@ from deeplearning4j_tpu.parallel.pipeline import GPipe, pipeline_train_step, sta
 from deeplearning4j_tpu.parallel.expert import (
     init_moe_params, moe_param_specs, place_moe_params, switch_moe,
 )
+from deeplearning4j_tpu.parallel.spark import (
+    ParameterAveragingTrainingMaster, SharedTrainingMaster,
+    SparkComputationGraph, SparkDl4jMultiLayer,
+)
 from deeplearning4j_tpu.parallel.distributed import (
     FaultTolerantTrainer, initialize_distributed,
 )
@@ -24,4 +28,6 @@ from deeplearning4j_tpu.parallel.distributed import (
 __all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference", "TensorParallel",
            "GPipe", "pipeline_train_step", "stack_stage_params",
            "init_moe_params", "moe_param_specs", "place_moe_params",
-           "switch_moe", "FaultTolerantTrainer", "initialize_distributed"]
+           "switch_moe", "FaultTolerantTrainer", "initialize_distributed",
+           "SparkDl4jMultiLayer", "SparkComputationGraph",
+           "ParameterAveragingTrainingMaster", "SharedTrainingMaster"]

@@ -112,8 +112,8 @@ def switch_moe_reference(params, x, *, capacity_factor: float = 1.25,
         if counts[e] >= C:
             continue
         counts[e] += 1
-        h = np.maximum(xt[n] @ np.asarray(params["W1"][e]) +
-                       np.asarray(params["b1"][e])[0], 0)
+        pre = xt[n] @ np.asarray(params["W1"][e]) + np.asarray(params["b1"][e])[0]
+        h = np.asarray(activation(jnp.asarray(pre)))
         out = h @ np.asarray(params["W2"][e]) + np.asarray(params["b2"][e])[0]
         y[n] = out * g[n, e]
     return y.reshape(orig_shape)

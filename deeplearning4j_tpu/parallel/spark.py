@@ -1,0 +1,102 @@
+"""Spark-API compatibility shims.
+
+Reference analog: deeplearning4j-scaleout/spark —
+org.deeplearning4j.spark.impl.multilayer.SparkDl4jMultiLayer +
+paramavg.ParameterAveragingTrainingMaster / SharedTrainingMaster. Those
+classes exist because the reference needs Spark to place replicas on
+executors and a parameter server to reconcile them. On TPU the SAME user
+intent ("train this config across the cluster") is one SPMD program over the
+mesh, so these shims keep the reference's surface (builder with
+batchSizePerWorker / averagingFrequency) while delegating to ParallelWrapper
+— averaging frequency is accepted and irrelevant: synchronous SPMD keeps
+replicas exactly equal every step, which is averaging at frequency 1 with
+zero communication code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import DeviceMesh
+
+
+@dataclasses.dataclass
+class ParameterAveragingTrainingMaster:
+    """Config carrier (ParameterAveragingTrainingMaster.Builder analog)."""
+
+    batch_size_per_worker: int = 32
+    averaging_frequency: int = 1  # accepted; SPMD is exact averaging every step
+    worker_prefetch_num_batches: int = 2
+
+    class Builder:
+        def __init__(self, rdd_data_set_num_examples: int = 1):
+            self._batch = 32
+            self._freq = 1
+            self._prefetch = 2
+
+        def batch_size_per_worker(self, n: int):
+            self._batch = n
+            return self
+
+        def averaging_frequency(self, n: int):
+            self._freq = n
+            return self
+
+        def worker_prefetch_num_batches(self, n: int):
+            self._prefetch = n
+            return self
+
+        def build(self) -> "ParameterAveragingTrainingMaster":
+            return ParameterAveragingTrainingMaster(
+                batch_size_per_worker=self._batch,
+                averaging_frequency=self._freq,
+                worker_prefetch_num_batches=self._prefetch)
+
+
+# SharedTrainingMaster (gradient sharing over Aeron) collapses to the same
+# SPMD program; keep the name so reference users find it.
+SharedTrainingMaster = ParameterAveragingTrainingMaster
+
+
+class SparkDl4jMultiLayer:
+    """SparkDl4jMultiLayer(sc, conf, trainingMaster) analog.
+
+    The "SparkContext" slot takes a DeviceMesh (or None for all devices) —
+    the mesh IS the cluster. fit() trains data-parallel over it.
+    """
+
+    def __init__(self, mesh: Optional[DeviceMesh], network_or_conf,
+                 training_master: Optional[ParameterAveragingTrainingMaster] = None):
+        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        if isinstance(network_or_conf, MultiLayerConfiguration):
+            self.network = MultiLayerNetwork(network_or_conf).init()
+        else:
+            self.network = network_or_conf
+        self.training_master = training_master or ParameterAveragingTrainingMaster()
+        self._wrapper = ParallelWrapper(
+            self.network, mesh or DeviceMesh(),
+            prefetch_buffer=self.training_master.worker_prefetch_num_batches)
+
+    def fit(self, data, epochs: int = 1):
+        """fit(rdd-like iterator of DataSets)."""
+        self._wrapper.fit(data, epochs=epochs)
+        return self.network
+
+    def get_network(self):
+        return self.network
+
+
+class SparkComputationGraph(SparkDl4jMultiLayer):
+    """SparkComputationGraph analog — same collapse, graph models."""
+
+    def __init__(self, mesh, network_or_conf, training_master=None):
+        from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        if isinstance(network_or_conf, ComputationGraphConfiguration):
+            network_or_conf = ComputationGraph(network_or_conf).init()
+        super().__init__(mesh, network_or_conf, training_master)

@@ -107,3 +107,64 @@ class TestRunner:
         assert len(runner.results) == 4
         # the sane lr clearly beats lr=0.001 in 30 steps
         assert best.hyperparams["lr"] == 0.3
+
+
+class TestMultiLayerSpace:
+    def test_sample_and_search(self, rng):
+        from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                                IntegerParameterSpace,
+                                                MaxCandidatesCondition,
+                                                MultiLayerSpace,
+                                                OptimizationRunner)
+        from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optimize import Adam
+
+        lr_space = ContinuousParameterSpace(1e-3, 1e-1, log_scale=True)
+        space = (MultiLayerSpace.builder()
+                 .updater_space(lambda r: Adam(lr=lr_space.sample(r)))
+                 .add_layer(DenseLayer(n_out=IntegerParameterSpace(4, 32),
+                                       activation="relu"))
+                 .add_layer(OutputLayer(n_out=3, activation="softmax",
+                                        loss="mcxent"))
+                 .set_input_type(InputType.feed_forward(6))
+                 .build())
+        conf = space.sample(np.random.default_rng(0))
+        assert 4 <= conf.layers[0].n_out <= 32
+
+        x = rng.normal(size=(48, 6)).astype(np.float32)
+        w = rng.normal(size=(6, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+        def build(hp):
+            model = MultiLayerNetwork(hp["conf"]).init()
+            for _ in range(25):
+                model.fit_batch((x, y))
+            return model
+
+        runner = OptimizationRunner(
+            space.candidate_generator(seed=1), build,
+            score_fn=lambda m: m.score((x, y)),
+            termination_conditions=[MaxCandidatesCondition(4)])
+        best = runner.execute()
+        assert np.isfinite(best.score)
+        assert len(runner.results) == 4
+
+
+class TestEvaluationCalibration:
+    def test_reliability_and_ece(self, rng):
+        from deeplearning4j_tpu.eval import EvaluationCalibration
+
+        n = 2000
+        # perfectly calibrated synthetic predictor
+        conf = rng.uniform(0.5, 1.0, n)
+        correct = rng.random(n) < conf
+        labels = np.zeros((n, 2), np.float32)
+        preds = np.zeros((n, 2), np.float32)
+        preds[:, 0] = conf
+        preds[:, 1] = 1 - conf
+        labels[np.arange(n), np.where(correct, 0, 1)] = 1.0
+        ev = EvaluationCalibration(n_bins=10).eval(labels, preds)
+        c, a, counts = ev.reliability_curve()
+        assert counts.sum() == n
+        assert ev.expected_calibration_error() < 0.08

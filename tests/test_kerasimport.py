@@ -99,10 +99,8 @@ class TestKerasDense:
         e = np.exp(logits - logits.max(-1, keepdims=True))
         expected = e / e.sum(-1, keepdims=True)
         out = np.asarray(model.output(x))
-        # model's LastTimeStep behavior: our import keeps the sequence; take
-        # final-step output if 3D
-        if out.ndim == 3:
-            out = out[:, -1]
+        # return_sequences=False (Keras default) must yield last-step-only 2D
+        assert out.ndim == 2, out.shape
         np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
 
     def test_batchnorm_inference(self, tmp_path, rng):

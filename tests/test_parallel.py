@@ -261,3 +261,29 @@ class TestExpertParallel:
                 params, l = step(params)
                 losses.append(float(l))
         assert losses[-1] < losses[0] * 0.75, (losses[0], losses[-1])
+
+
+class TestSparkShims:
+    def test_spark_dl4j_multilayer(self, rng):
+        """SparkDl4jMultiLayer surface trains DP over the mesh (the reference
+        Spark stack collapsed into SPMD)."""
+        from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+        from deeplearning4j_tpu.parallel import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer,
+        )
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(lr=0.3))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(5).build())
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        w = rng.normal(size=(4, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+        it = ArrayDataSetIterator(x, y, batch_size=32)
+        spark_net = SparkDl4jMultiLayer(DeviceMesh(data=8), conf, tm)
+        net = spark_net.fit(it, epochs=15)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.8
