@@ -82,6 +82,23 @@ def make_ours(batch):
             jnp.asarray(0, jnp.int32), jnp.asarray(0.0))
         return batch / _measure(one, args, loss_index=4)
 
+    flops_cache = []
+
+    def flops_per_step():
+        if not flops_cache:
+            try:
+                comp = step.lower(*state0, jnp.asarray(0, jnp.int32),
+                                  {"input": x}, {"output": y}, key,
+                                  None).compile()
+                ca = comp.cost_analysis()
+                if isinstance(ca, list):
+                    ca = ca[0]
+                flops_cache.append(float(ca.get("flops", 0.0)))
+            except Exception:
+                flops_cache.append(0.0)
+        return flops_cache[0]
+
+    measure.flops_per_step = flops_per_step
     return measure
 
 
@@ -176,6 +193,9 @@ def main():
         # Shared tunneled backends drift +/-30% over minutes; interleave A/B
         # rounds and report the median throughput and median per-round ratio.
         ours_fn = make_ours(b)
+        # AOT-compile once up front; with the persistent cache enabled the
+        # timed jit path below reuses this XLA compile instead of repeating it
+        ours_fn.flops_per_step()
         try:
             flax_fn = make_flax_reference(b)
         except Exception:
@@ -191,18 +211,41 @@ def main():
                     flax_fn = None  # keep reporting ours even if ref dies
         med = sorted(ours_runs)[len(ours_runs) // 2]
         vs = sorted(ratios)[len(ratios) // 2] if ratios else None
-        return med, vs
+        return med, vs, ours_fn
+
+    def peak_flops():
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        table = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+                 "v6e": 918e12, "v6 lite": 918e12}
+        for name, peak in table.items():
+            if name in kind:
+                return peak
+        return None  # unknown device: report mfu=null, not a guess
 
     try:
-        med, vs = run_rounds(batch)
+        med, vs, ours_fn = run_rounds(batch)
     except Exception:  # OOM during compile/execute: retry at half batch
         batch = batch // 2
-        med, vs = run_rounds(batch)
+        med, vs, ours_fn = run_rounds(batch)
+
+    # MFU: XLA-counted flops/step x steps/sec over chip peak (the BASELINE
+    # metric is samples/sec/chip + MFU)
+    mfu = None
+    try:
+        peak = peak_flops()
+        flops = ours_fn.flops_per_step()
+        if flops and peak:
+            mfu = flops * (med / batch) / peak
+    except Exception:
+        mfu = None
     print(json.dumps({
         "metric": "ResNet-50 ImageNet train throughput (zoo entrypoint, bf16, batch %d, median of %d interleaved rounds)" % (batch, rounds),
         "value": round(med, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": None if vs is None else round(vs, 4),
+        "mfu": None if mfu is None else round(mfu, 4),
     }))
 
 

@@ -51,9 +51,12 @@ class MultiLayerSpace:
         self._input_type = input_type
         self._updater_fn = updater_fn
         self._seed = seed
+        self._rng = np.random.default_rng(seed)
 
     def sample(self, rng=None):
-        rng = rng or np.random.default_rng(self._seed)
+        # default to the instance rng so repeated sample() calls draw NEW
+        # candidates (a fresh rng per call would resample the same point)
+        rng = rng if rng is not None else self._rng
         b = NeuralNetConfiguration.builder().seed(int(rng.integers(1 << 30)))
         if self._updater_fn is not None:
             b = b.updater(self._updater_fn(rng))
@@ -75,6 +78,7 @@ class MultiLayerSpace:
             self._layers: List = []
             self._input_type: Optional[InputType] = None
             self._updater_fn = None
+            self._seed = 0
 
         def add_layer(self, layer) -> "MultiLayerSpace.Builder":
             self._layers.append(layer)
@@ -89,11 +93,15 @@ class MultiLayerSpace:
             self._input_type = itype
             return self
 
+        def seed(self, s: int) -> "MultiLayerSpace.Builder":
+            self._seed = s
+            return self
+
         def build(self) -> "MultiLayerSpace":
             if self._input_type is None:
                 raise ValueError("MultiLayerSpace requires an input type")
             return MultiLayerSpace(self._layers, self._input_type,
-                                   self._updater_fn)
+                                   self._updater_fn, seed=self._seed)
 
     @staticmethod
     def builder() -> "MultiLayerSpace.Builder":

@@ -82,12 +82,53 @@ class SparkDl4jMultiLayer:
             prefetch_buffer=self.training_master.worker_prefetch_num_batches)
 
     def fit(self, data, epochs: int = 1):
-        """fit(rdd-like iterator of DataSets)."""
-        self._wrapper.fit(data, epochs=epochs)
+        """fit(rdd-like iterator of DataSets).
+
+        The iterator is re-batched to batch_size_per_worker x data-parallel
+        degree (the reference re-splits the RDD to batchSizePerWorker per
+        executor; here the global SPMD batch is the per-worker size times the
+        mesh's data axis)."""
+        global_batch = (self.training_master.batch_size_per_worker
+                        * self._wrapper.mesh.shape["data"])
+        self._wrapper.fit(_RebatchingIterator(data, global_batch),
+                          epochs=epochs)
         return self.network
 
     def get_network(self):
         return self.network
+
+
+class _RebatchingIterator:
+    """Re-batches an iterator of DataSets to a fixed global batch size
+    (drop-last semantics, like the reference's RDD repartitioning)."""
+
+    def __init__(self, source, batch_size: int):
+        self._source = source
+        self._batch = batch_size
+
+    def reset(self):
+        if hasattr(self._source, "reset"):
+            self._source.reset()
+
+    def __iter__(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.multilayer import _unpack
+
+        feats, labels = [], []
+        have = 0
+        for ds in self._source:
+            x, y, _ = _unpack(ds)
+            feats.append(np.asarray(x))
+            labels.append(np.asarray(y))
+            have += feats[-1].shape[0]
+            while have >= self._batch:
+                fx = np.concatenate(feats)
+                fy = np.concatenate(labels)
+                yield DataSet(fx[:self._batch], fy[:self._batch])
+                feats, labels = [fx[self._batch:]], [fy[self._batch:]]
+                have = feats[0].shape[0]
 
 
 class SparkComputationGraph(SparkDl4jMultiLayer):
