@@ -11,16 +11,395 @@ trace-and-compile, so there is no per-op dispatch loop at runtime at all.
 Gradients come from jax.grad over the traced function (the reference builds
 an explicit backward graph; XLA's autodiff is the same construction done by
 the compiler).
+
+Serialization mirrors the reference's FlatBuffers `.fb` graph+weights file
+(SameDiff.save/SameDiff.load): every op node stores a registry op-name plus
+JSON-able attributes, so a saved graph reloads into an executable SameDiff
+with no Python closures involved. Control-flow ops (cond/while_loop/scan)
+lower onto lax control flow and serialize their sub-graphs recursively.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+import io
+import json
+import zipfile
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# --------------------------------------------------------------------------
+# Op registry: name -> builder(attrs) -> callable(*inputs).
+# This is the SameDiff analog of the DifferentialFunction registry
+# (org.nd4j.imports.converters.DifferentialFunctionClassHolder): ops are
+# identified by name so graphs serialize without code.
+# --------------------------------------------------------------------------
+
+_OP_IMPLS: dict[str, Callable[[dict], Callable]] = {}
+
+
+def register_sd_op(name: str):
+    """Register a SameDiff graph op builder (attrs -> callable).
+
+    Distinct from deeplearning4j_tpu.ops.registry.register_op, which registers
+    runtime kernel implementations (XLA/Pallas platform selection); this table
+    maps serialized graph-node names onto callables.
+    """
+    def deco(builder):
+        _OP_IMPLS[name] = builder
+        return builder
+    return deco
+
+
+def _simple(name: str, fn: Callable):
+    _OP_IMPLS[name] = lambda attrs, _f=fn: _f
+
+
+# elementwise / binary
+_simple("add", jnp.add)
+_simple("sub", jnp.subtract)
+_simple("rsub", lambda a, b: b - a)
+_simple("mul", jnp.multiply)
+_simple("div", jnp.divide)
+_simple("rdiv", lambda a, b: b / a)
+_simple("pow", jnp.power)
+_simple("mod", jnp.mod)
+_simple("floordiv", jnp.floor_divide)
+_simple("maximum", jnp.maximum)
+_simple("minimum", jnp.minimum)
+_simple("neg", jnp.negative)
+_simple("exp", jnp.exp)
+_simple("log", jnp.log)
+_simple("log1p", jnp.log1p)
+_simple("expm1", jnp.expm1)
+_simple("sqrt", jnp.sqrt)
+_simple("rsqrt", lambda a: 1.0 / jnp.sqrt(a))
+_simple("square", jnp.square)
+_simple("abs", jnp.abs)
+_simple("sign", jnp.sign)
+_simple("floor", jnp.floor)
+_simple("ceil", jnp.ceil)
+_simple("round", jnp.round)
+_simple("reciprocal", jnp.reciprocal)
+_simple("sin", jnp.sin)
+_simple("cos", jnp.cos)
+_simple("tan", jnp.tan)
+_simple("asin", jnp.arcsin)
+_simple("acos", jnp.arccos)
+_simple("atan", jnp.arctan)
+_simple("sinh", jnp.sinh)
+_simple("cosh", jnp.cosh)
+_simple("tanh", jnp.tanh)
+_simple("erf", jax.scipy.special.erf)
+_simple("sigmoid", jax.nn.sigmoid)
+_simple("relu", jax.nn.relu)
+_simple("relu6", jax.nn.relu6)
+_simple("elu", jax.nn.elu)
+_simple("gelu", jax.nn.gelu)
+_simple("softplus", jax.nn.softplus)
+_simple("softsign", jax.nn.soft_sign)
+_simple("silu", jax.nn.silu)
+_simple("hardswish", jax.nn.hard_swish)
+_simple("mmul", jnp.matmul)
+_simple("bmm", jnp.matmul)
+_simple("where", jnp.where)
+# comparisons (emit bool; cast as needed)
+_simple("eq", jnp.equal)
+_simple("neq", jnp.not_equal)
+_simple("gt", jnp.greater)
+_simple("gte", jnp.greater_equal)
+_simple("lt", jnp.less)
+_simple("lte", jnp.less_equal)
+_simple("logical_and", jnp.logical_and)
+_simple("logical_or", jnp.logical_or)
+_simple("logical_not", jnp.logical_not)
+
+
+@register_sd_op("leakyrelu")
+def _b_leakyrelu(attrs):
+    alpha = attrs.get("alpha", 0.01)
+    return lambda a: jax.nn.leaky_relu(a, alpha)
+
+
+@register_sd_op("softmax")
+def _b_softmax(attrs):
+    axis = attrs.get("axis", -1)
+    return lambda a: jax.nn.softmax(a, axis=axis)
+
+
+@register_sd_op("log_softmax")
+def _b_log_softmax(attrs):
+    axis = attrs.get("axis", -1)
+    return lambda a: jax.nn.log_softmax(a, axis=axis)
+
+
+def _reduce(name, jfn):
+    @register_sd_op(name)
+    def _b(attrs, _jfn=jfn):
+        axis = attrs.get("axis")
+        axis = tuple(axis) if isinstance(axis, list) else axis
+        keepdims = attrs.get("keepdims", False)
+        return lambda a: _jfn(a, axis=axis, keepdims=keepdims)
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+_reduce("prod", jnp.prod)
+_reduce("std", jnp.std)
+_reduce("var", jnp.var)
+_reduce("any", jnp.any)
+_reduce("all", jnp.all)
+
+
+@register_sd_op("norm1")
+def _b_norm1(attrs):
+    axis = attrs.get("axis")
+    keepdims = attrs.get("keepdims", False)
+    return lambda a: jnp.sum(jnp.abs(a), axis=None if axis is None else tuple(axis),
+                             keepdims=keepdims)
+
+
+@register_sd_op("norm2")
+def _b_norm2(attrs):
+    axis = attrs.get("axis")
+    keepdims = attrs.get("keepdims", False)
+    return lambda a: jnp.sqrt(jnp.sum(a * a, axis=None if axis is None else tuple(axis),
+                                      keepdims=keepdims))
+
+
+@register_sd_op("normmax")
+def _b_normmax(attrs):
+    axis = attrs.get("axis")
+    keepdims = attrs.get("keepdims", False)
+    return lambda a: jnp.max(jnp.abs(a), axis=None if axis is None else tuple(axis),
+                             keepdims=keepdims)
+
+
+@register_sd_op("argmax")
+def _b_argmax(attrs):
+    return lambda a: jnp.argmax(a, axis=attrs.get("axis", -1))
+
+
+@register_sd_op("argmin")
+def _b_argmin(attrs):
+    return lambda a: jnp.argmin(a, axis=attrs.get("axis", -1))
+
+
+@register_sd_op("cumsum")
+def _b_cumsum(attrs):
+    return lambda a: jnp.cumsum(a, axis=attrs.get("axis", -1))
+
+
+@register_sd_op("cumprod")
+def _b_cumprod(attrs):
+    return lambda a: jnp.cumprod(a, axis=attrs.get("axis", -1))
+
+
+@register_sd_op("reshape")
+def _b_reshape(attrs):
+    shape = tuple(attrs["shape"])
+    return lambda a: jnp.reshape(a, shape)
+
+
+@register_sd_op("transpose")
+def _b_transpose(attrs):
+    axes = attrs.get("axes")
+    return lambda a: jnp.transpose(a, tuple(axes) if axes else None)
+
+
+@register_sd_op("squeeze")
+def _b_squeeze(attrs):
+    axis = attrs.get("axis")
+    return lambda a: jnp.squeeze(a, axis=None if axis is None else tuple(axis))
+
+
+@register_sd_op("expand_dims")
+def _b_expand_dims(attrs):
+    return lambda a: jnp.expand_dims(a, attrs["axis"])
+
+
+@register_sd_op("tile")
+def _b_tile(attrs):
+    return lambda a: jnp.tile(a, tuple(attrs["reps"]))
+
+
+@register_sd_op("slice")
+def _b_slice(attrs):
+    begin, size = attrs["begin"], attrs["size"]
+    return lambda a: jax.lax.dynamic_slice(a, tuple(begin), tuple(size))
+
+
+@register_sd_op("strided_slice")
+def _b_strided_slice(attrs):
+    sl = tuple(slice(b, e, s) for b, e, s in
+               zip(attrs["begin"], attrs["end"], attrs["strides"]))
+    return lambda a: a[sl]  # end=None means "to the end" (JSON null)
+
+
+@register_sd_op("gather")
+def _b_gather(attrs):
+    axis = attrs.get("axis", 0)
+    return lambda a, idx: jnp.take(a, idx.astype(jnp.int32), axis=axis)
+
+
+@register_sd_op("scatter_update")
+def _b_scatter_update(attrs):
+    return lambda a, idx, upd: a.at[idx.astype(jnp.int32)].set(upd)
+
+
+@register_sd_op("scatter_add")
+def _b_scatter_add(attrs):
+    return lambda a, idx, upd: a.at[idx.astype(jnp.int32)].add(upd)
+
+
+@register_sd_op("one_hot")
+def _b_one_hot(attrs):
+    depth = attrs["depth"]
+    return lambda a: jax.nn.one_hot(a.astype(jnp.int32), depth)
+
+
+@register_sd_op("cast")
+def _b_cast(attrs):
+    dtype = jnp.dtype(attrs["dtype"])
+    return lambda a: a.astype(dtype)
+
+
+@register_sd_op("clip_by_value")
+def _b_clip(attrs):
+    lo, hi = attrs["min"], attrs["max"]
+    return lambda a: jnp.clip(a, lo, hi)
+
+
+@register_sd_op("concat")
+def _b_concat(attrs):
+    axis = attrs.get("axis", -1)
+    return lambda *xs: jnp.concatenate(xs, axis=axis)
+
+
+@register_sd_op("stack")
+def _b_stack(attrs):
+    axis = attrs.get("axis", 0)
+    return lambda *xs: jnp.stack(xs, axis=axis)
+
+
+@register_sd_op("unstack")
+def _b_unstack(attrs):
+    axis, index = attrs.get("axis", 0), attrs["index"]
+    return lambda a: jnp.take(a, index, axis=axis)
+
+
+@register_sd_op("split")
+def _b_split(attrs):
+    n, axis, index = attrs["num"], attrs.get("axis", 0), attrs["index"]
+    return lambda a: jnp.split(a, n, axis=axis)[index]
+
+
+@register_sd_op("conv2d")
+def _b_conv2d(attrs):
+    from deeplearning4j_tpu.ops.convolution import conv2d as _c
+    strides = tuple(attrs.get("strides", (1, 1)))
+    padding = attrs.get("padding", "same")
+    return lambda x, w: _c(x, w, strides=strides, padding=padding)
+
+
+@register_sd_op("max_pool2d")
+def _b_maxpool(attrs):
+    from deeplearning4j_tpu.ops.convolution import maxpool2d
+    k = tuple(attrs.get("kernel", (2, 2)))
+    s = tuple(attrs.get("strides", k))
+    pad = attrs.get("padding", "valid")
+    return lambda x: maxpool2d(x, kernel=k, strides=s, padding=pad)
+
+
+@register_sd_op("avg_pool2d")
+def _b_avgpool(attrs):
+    from deeplearning4j_tpu.ops.convolution import avgpool2d
+    k = tuple(attrs.get("kernel", (2, 2)))
+    s = tuple(attrs.get("strides", k))
+    pad = attrs.get("padding", "valid")
+    return lambda x: avgpool2d(x, kernel=k, strides=s, padding=pad)
+
+
+@register_sd_op("layer_norm")
+def _b_layernorm(attrs):
+    eps = attrs.get("eps", 1e-5)
+
+    def fn(x, gain, bias):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + eps) * gain + bias
+    return fn
+
+
+@register_sd_op("batch_norm")
+def _b_batchnorm(attrs):
+    eps = attrs.get("eps", 1e-5)
+
+    def fn(x, mean, var, gamma, beta):
+        return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+    return fn
+
+
+@register_sd_op("embedding_lookup")
+def _b_embed(attrs):
+    return lambda table, ids: jnp.take(table, ids.astype(jnp.int32), axis=0)
+
+
+@register_sd_op("softmax_ce")
+def _b_softmax_ce(attrs):
+    def ce(y, z):
+        return -(y * jax.nn.log_softmax(z, -1)).sum(-1).mean()
+    return ce
+
+
+@register_sd_op("sigmoid_ce")
+def _b_sigmoid_ce(attrs):
+    def ce(y, z):
+        return jnp.mean(jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z))))
+    return ce
+
+
+@register_sd_op("mse")
+def _b_mse(attrs):
+    return lambda y, p: ((y - p) ** 2).mean()
+
+
+@register_sd_op("l1_loss")
+def _b_l1(attrs):
+    return lambda y, p: jnp.abs(y - p).mean()
+
+
+@register_sd_op("l2_loss")
+def _b_l2(attrs):
+    return lambda a: 0.5 * jnp.sum(a * a)
+
+
+@register_sd_op("huber_loss")
+def _b_huber(attrs):
+    delta = attrs.get("delta", 1.0)
+
+    def fn(y, p):
+        err = jnp.abs(y - p)
+        return jnp.mean(jnp.where(err <= delta, 0.5 * err * err,
+                                  delta * (err - 0.5 * delta)))
+    return fn
+
+
+@register_sd_op("identity")
+def _b_identity(attrs):
+    return lambda a: a
+
+
+@register_sd_op("pad")
+def _b_pad(attrs):
+    pads = [tuple(p) for p in attrs["paddings"]]
+    mode = attrs.get("mode", "constant")
+    return lambda a: jnp.pad(a, pads, mode=mode)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,55 +411,105 @@ class SDVariable:
 
     # -- operator sugar; every op routes through sd._op --
     def __add__(self, o):
-        return self.sd._op("add", jnp.add, self, o)
+        return self.sd._op("add", self, o)
 
     __radd__ = __add__
 
     def __sub__(self, o):
-        return self.sd._op("sub", jnp.subtract, self, o)
+        return self.sd._op("sub", self, o)
 
     def __rsub__(self, o):
-        return self.sd._op("rsub", lambda a, b: b - a, self, o)
+        return self.sd._op("rsub", self, o)
 
     def __mul__(self, o):
-        return self.sd._op("mul", jnp.multiply, self, o)
+        return self.sd._op("mul", self, o)
 
     __rmul__ = __mul__
 
     def __truediv__(self, o):
-        return self.sd._op("div", jnp.divide, self, o)
+        return self.sd._op("div", self, o)
+
+    def __rtruediv__(self, o):
+        return self.sd._op("rdiv", self, o)
+
+    def __pow__(self, o):
+        return self.sd._op("pow", self, o)
 
     def __neg__(self):
-        return self.sd._op("neg", jnp.negative, self)
+        return self.sd._op("neg", self)
 
     def __matmul__(self, o):
         return self.sd.mmul(self, o)
 
+    def __getitem__(self, item):
+        if not isinstance(item, tuple):
+            item = (item,)
+        begin, end, strides, int_dims = [], [], [], []
+        for d, s in enumerate(item):
+            if isinstance(s, slice):
+                # keep None for open ends so negative steps (e.g. ::-1) work
+                begin.append(s.start)
+                end.append(s.stop)
+                strides.append(1 if s.step is None else s.step)
+            else:
+                # integer index: slice [s, s+1) (end=None when s == -1 so the
+                # slice isn't empty), then squeeze the dim like numpy does
+                begin.append(s)
+                end.append(s + 1 if s != -1 else None)
+                strides.append(1)
+                int_dims.append(d)
+        out = self.sd._op("strided_slice", self,
+                          attrs={"begin": begin, "end": end, "strides": strides})
+        if int_dims:
+            out = self.sd.squeeze(out, axis=int_dims)
+        return out
+
     # common shortcuts
     def sum(self, axis=None, keepdims=False):
-        return self.sd._op("sum", lambda a: jnp.sum(a, axis=axis, keepdims=keepdims), self)
+        return self.sd.sum(self, axis=axis, keepdims=keepdims)
 
     def mean(self, axis=None, keepdims=False):
-        return self.sd._op("mean", lambda a: jnp.mean(a, axis=axis, keepdims=keepdims), self)
+        return self.sd.mean(self, axis=axis, keepdims=keepdims)
+
+    def std(self, axis=None, keepdims=False):
+        return self.sd._op("std", self, attrs={"axis": _axlist(axis), "keepdims": keepdims})
 
     def reshape(self, *shape):
-        return self.sd._op("reshape", lambda a: jnp.reshape(a, shape), self)
+        return self.sd._op("reshape", self, attrs={"shape": list(shape)})
 
     def transpose(self, *axes):
-        return self.sd._op("transpose", lambda a: jnp.transpose(a, axes or None), self)
+        return self.sd._op("transpose", self, attrs={"axes": list(axes) if axes else None})
 
     def eval(self, **placeholders):
         return self.sd.output(self.name, **placeholders)
+
+    @property
+    def shape(self):
+        node = self.sd._nodes[self.name]
+        if node.value is not None:
+            return tuple(node.value.shape)
+        return tuple(node.shape) if node.shape else None
+
+
+def _axlist(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (int, np.integer)):
+        return [int(axis)]
+    return [int(a) for a in axis]
 
 
 @dataclasses.dataclass
 class _Node:
     name: str
-    kind: str  # "placeholder" | "variable" | "constant" | "op"
-    fn: Optional[Callable] = None
+    kind: str  # "placeholder" | "variable" | "constant" | "op" | "control"
+    op: Optional[str] = None          # registry op name (kind == "op")
+    attrs: dict = dataclasses.field(default_factory=dict)
     inputs: tuple = ()
     value: Any = None  # for variable/constant: concrete array
     shape: Optional[tuple] = None
+    fn: Optional[Callable] = None     # kind == "control": lowered lax closure
+    subgraphs: dict = dataclasses.field(default_factory=dict)  # name -> SameDiff
 
 
 class SameDiff:
@@ -125,7 +554,10 @@ class SameDiff:
         name = name or self._fresh("const")
         return self._add(_Node(name, "constant", value=jnp.asarray(value)))
 
-    def _op(self, base: str, fn: Callable, *args, name: Optional[str] = None) -> SDVariable:
+    def _op(self, op: str, *args, attrs: Optional[dict] = None,
+            name: Optional[str] = None) -> SDVariable:
+        if op not in _OP_IMPLS:
+            raise KeyError(f"unknown SameDiff op {op!r}")
         inputs = []
         for a in args:
             if isinstance(a, SDVariable):
@@ -133,90 +565,293 @@ class SameDiff:
             else:
                 c = self.constant(a)
                 inputs.append(c.name)
-        name = name or self._fresh(base)
-        return self._add(_Node(name, "op", fn=fn, inputs=tuple(inputs)))
+        name = name or self._fresh(op)
+        return self._add(_Node(name, "op", op=op, attrs=dict(attrs or {}),
+                               inputs=tuple(inputs)))
+
+    def getVariable(self, name: str) -> SDVariable:
+        if name not in self._nodes:
+            raise KeyError(name)
+        return SDVariable(self, name)
 
     # ---------------------------------------------------------- op catalog
-    # (mirrors SDBaseOps/SDNN/SDMath method surface; each is one XLA op)
+    # (mirrors SDBaseOps/SDNN/SDMath/SDLoss method surface; each op is a
+    # registry name so the graph serializes — no closures.)
     def mmul(self, a, b, name=None):
-        return self._op("mmul", jnp.matmul, a, b, name=name)
+        return self._op("mmul", a, b, name=name)
 
     def add(self, a, b, name=None):
-        return self._op("add", jnp.add, a, b, name=name)
+        return self._op("add", a, b, name=name)
 
     def sub(self, a, b, name=None):
-        return self._op("sub", jnp.subtract, a, b, name=name)
+        return self._op("sub", a, b, name=name)
 
     def mul(self, a, b, name=None):
-        return self._op("mul", jnp.multiply, a, b, name=name)
+        return self._op("mul", a, b, name=name)
 
     def div(self, a, b, name=None):
-        return self._op("div", jnp.divide, a, b, name=name)
+        return self._op("div", a, b, name=name)
+
+    def pow(self, a, b, name=None):
+        return self._op("pow", a, b, name=name)
 
     def exp(self, a, name=None):
-        return self._op("exp", jnp.exp, a, name=name)
+        return self._op("exp", a, name=name)
 
     def log(self, a, name=None):
-        return self._op("log", jnp.log, a, name=name)
+        return self._op("log", a, name=name)
 
     def sqrt(self, a, name=None):
-        return self._op("sqrt", jnp.sqrt, a, name=name)
+        return self._op("sqrt", a, name=name)
 
     def square(self, a, name=None):
-        return self._op("square", jnp.square, a, name=name)
+        return self._op("square", a, name=name)
 
     def abs(self, a, name=None):
-        return self._op("abs", jnp.abs, a, name=name)
+        return self._op("abs", a, name=name)
+
+    def sin(self, a, name=None):
+        return self._op("sin", a, name=name)
+
+    def cos(self, a, name=None):
+        return self._op("cos", a, name=name)
 
     def tanh(self, a, name=None):
-        return self._op("tanh", jnp.tanh, a, name=name)
+        return self._op("tanh", a, name=name)
+
+    def erf(self, a, name=None):
+        return self._op("erf", a, name=name)
 
     def sigmoid(self, a, name=None):
-        return self._op("sigmoid", jax.nn.sigmoid, a, name=name)
+        return self._op("sigmoid", a, name=name)
 
     def relu(self, a, name=None):
-        return self._op("relu", jax.nn.relu, a, name=name)
+        return self._op("relu", a, name=name)
+
+    def gelu(self, a, name=None):
+        return self._op("gelu", a, name=name)
+
+    def elu(self, a, name=None):
+        return self._op("elu", a, name=name)
+
+    def leakyrelu(self, a, alpha=0.01, name=None):
+        return self._op("leakyrelu", a, attrs={"alpha": alpha}, name=name)
 
     def softmax(self, a, axis=-1, name=None):
-        return self._op("softmax", lambda x: jax.nn.softmax(x, axis=axis), a, name=name)
+        return self._op("softmax", a, attrs={"axis": axis}, name=name)
 
     def log_softmax(self, a, axis=-1, name=None):
-        return self._op("log_softmax", lambda x: jax.nn.log_softmax(x, axis=axis), a,
-                        name=name)
+        return self._op("log_softmax", a, attrs={"axis": axis}, name=name)
 
     def conv2d(self, x, w, strides=(1, 1), padding="same", name=None):
-        from deeplearning4j_tpu.ops.convolution import conv2d as _c
+        return self._op("conv2d", x, w,
+                        attrs={"strides": list(strides), "padding": padding}, name=name)
 
-        return self._op("conv2d", lambda a, b: _c(a, b, strides=strides, padding=padding),
-                        x, w, name=name)
+    def max_pool2d(self, x, kernel=(2, 2), strides=None, padding="valid", name=None):
+        return self._op("max_pool2d", x, attrs={
+            "kernel": list(kernel), "strides": list(strides or kernel),
+            "padding": padding}, name=name)
+
+    def avg_pool2d(self, x, kernel=(2, 2), strides=None, padding="valid", name=None):
+        return self._op("avg_pool2d", x, attrs={
+            "kernel": list(kernel), "strides": list(strides or kernel),
+            "padding": padding}, name=name)
+
+    def layer_norm(self, x, gain, bias, eps=1e-5, name=None):
+        return self._op("layer_norm", x, gain, bias, attrs={"eps": eps}, name=name)
+
+    def batch_norm(self, x, mean, var, gamma, beta, eps=1e-5, name=None):
+        return self._op("batch_norm", x, mean, var, gamma, beta,
+                        attrs={"eps": eps}, name=name)
+
+    def embedding_lookup(self, table, ids, name=None):
+        return self._op("embedding_lookup", table, ids, name=name)
 
     def batch_matmul(self, a, b, name=None):
-        return self._op("bmm", jnp.matmul, a, b, name=name)
+        return self._op("bmm", a, b, name=name)
+
+    def matmul(self, a, b, name=None):
+        return self._op("mmul", a, b, name=name)
 
     def sum(self, a, axis=None, keepdims=False, name=None):
-        return self._op("sum", lambda x: jnp.sum(x, axis=axis, keepdims=keepdims), a,
+        return self._op("sum", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
                         name=name)
 
     def mean(self, a, axis=None, keepdims=False, name=None):
-        return self._op("mean", lambda x: jnp.mean(x, axis=axis, keepdims=keepdims), a,
+        return self._op("mean", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
                         name=name)
 
     def max(self, a, axis=None, keepdims=False, name=None):
-        return self._op("max", lambda x: jnp.max(x, axis=axis, keepdims=keepdims), a,
+        return self._op("max", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
                         name=name)
+
+    def min(self, a, axis=None, keepdims=False, name=None):
+        return self._op("min", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def prod(self, a, axis=None, keepdims=False, name=None):
+        return self._op("prod", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def std(self, a, axis=None, keepdims=False, name=None):
+        return self._op("std", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def var_reduce(self, a, axis=None, keepdims=False, name=None):
+        return self._op("var", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def norm1(self, a, axis=None, keepdims=False, name=None):
+        return self._op("norm1", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def norm2(self, a, axis=None, keepdims=False, name=None):
+        return self._op("norm2", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def normmax(self, a, axis=None, keepdims=False, name=None):
+        return self._op("normmax", a, attrs={"axis": _axlist(axis), "keepdims": keepdims},
+                        name=name)
+
+    def argmax(self, a, axis=-1, name=None):
+        return self._op("argmax", a, attrs={"axis": axis}, name=name)
+
+    def argmin(self, a, axis=-1, name=None):
+        return self._op("argmin", a, attrs={"axis": axis}, name=name)
+
+    def cumsum(self, a, axis=-1, name=None):
+        return self._op("cumsum", a, attrs={"axis": axis}, name=name)
 
     def concat(self, vars, axis=-1, name=None):
-        return self._op("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *vars,
+        return self._op("concat", *vars, attrs={"axis": axis}, name=name)
+
+    def stack(self, vars, axis=0, name=None):
+        return self._op("stack", *vars, attrs={"axis": axis}, name=name)
+
+    def unstack(self, a, num, axis=0):
+        return [self._op("unstack", a, attrs={"axis": axis, "index": i})
+                for i in range(num)]
+
+    def split(self, a, num, axis=0):
+        return [self._op("split", a, attrs={"num": num, "axis": axis, "index": i})
+                for i in range(num)]
+
+    def gather(self, a, indices, axis=0, name=None):
+        return self._op("gather", a, indices, attrs={"axis": axis}, name=name)
+
+    def scatter_update(self, a, indices, updates, name=None):
+        return self._op("scatter_update", a, indices, updates, name=name)
+
+    def scatter_add(self, a, indices, updates, name=None):
+        return self._op("scatter_add", a, indices, updates, name=name)
+
+    def one_hot(self, a, depth, name=None):
+        return self._op("one_hot", a, attrs={"depth": depth}, name=name)
+
+    def cast(self, a, dtype, name=None):
+        return self._op("cast", a, attrs={"dtype": np.dtype(dtype).name}, name=name)
+
+    def clip_by_value(self, a, lo, hi, name=None):
+        return self._op("clip_by_value", a, attrs={"min": lo, "max": hi}, name=name)
+
+    def reshape(self, a, shape, name=None):
+        return self._op("reshape", a, attrs={"shape": list(shape)}, name=name)
+
+    def transpose_(self, a, axes=None, name=None):
+        return self._op("transpose", a, attrs={"axes": list(axes) if axes else None},
                         name=name)
 
-    def cross_entropy(self, labels, logits, name=None):
-        def ce(y, z):
-            return -(y * jax.nn.log_softmax(z, -1)).sum(-1).mean()
+    def squeeze(self, a, axis=None, name=None):
+        return self._op("squeeze", a, attrs={"axis": _axlist(axis)}, name=name)
 
-        return self._op("softmax_ce", ce, labels, logits, name=name)
+    def expand_dims(self, a, axis, name=None):
+        return self._op("expand_dims", a, attrs={"axis": axis}, name=name)
+
+    def tile(self, a, reps, name=None):
+        return self._op("tile", a, attrs={"reps": list(reps)}, name=name)
+
+    def slice(self, a, begin, size, name=None):
+        return self._op("slice", a, attrs={"begin": list(begin), "size": list(size)},
+                        name=name)
+
+    def eq(self, a, b, name=None):
+        return self._op("eq", a, b, name=name)
+
+    def gt(self, a, b, name=None):
+        return self._op("gt", a, b, name=name)
+
+    def lt(self, a, b, name=None):
+        return self._op("lt", a, b, name=name)
+
+    def where(self, cond, a, b, name=None):
+        return self._op("where", cond, a, b, name=name)
+
+    def identity(self, a, name=None):
+        return self._op("identity", a, name=name)
+
+    def pad(self, a, paddings, mode="constant", name=None):
+        return self._op("pad", a, attrs={"paddings": [list(p) for p in paddings],
+                                         "mode": mode}, name=name)
+
+    # losses (SDLoss surface)
+    def cross_entropy(self, labels, logits, name=None):
+        return self._op("softmax_ce", labels, logits, name=name)
+
+    def sigmoid_cross_entropy(self, labels, logits, name=None):
+        return self._op("sigmoid_ce", labels, logits, name=name)
 
     def mse(self, labels, pred, name=None):
-        return self._op("mse", lambda y, p: ((y - p) ** 2).mean(), labels, pred, name=name)
+        return self._op("mse", labels, pred, name=name)
+
+    def l1_loss(self, labels, pred, name=None):
+        return self._op("l1_loss", labels, pred, name=name)
+
+    def l2_loss(self, a, name=None):
+        return self._op("l2_loss", a, name=name)
+
+    def huber_loss(self, labels, pred, delta=1.0, name=None):
+        return self._op("huber_loss", labels, pred, attrs={"delta": delta}, name=name)
+
+    # ------------------------------------------------------- control flow
+    # Reference analog: SameDiff If/While ops (org.nd4j.autodiff.samediff
+    # control-flow scopes, imported from TF Switch/Merge/Enter/Exit).
+    # TPU-first: lower directly onto lax.cond / lax.while_loop / lax.scan —
+    # compiler-friendly structured control flow instead of dataflow tokens.
+    # Branch bodies are sub-SameDiff graphs so the whole thing serializes.
+    def cond(self, pred: SDVariable, true_graph: "SameDiff", false_graph: "SameDiff",
+             inputs: Sequence[SDVariable], name: Optional[str] = None) -> SDVariable:
+        """lax.cond over two single-output sub-graphs.
+
+        Each sub-graph must have placeholders named arg0..argN matching
+        ``inputs`` and exactly one terminal op named 'out'.
+        """
+        name = name or self._fresh("cond")
+        node = _Node(name, "control", op="cond",
+                     inputs=(pred.name,) + tuple(i.name for i in inputs),
+                     subgraphs={"true": true_graph, "false": false_graph})
+        return self._add(node)
+
+    def while_loop(self, cond_graph: "SameDiff", body_graph: "SameDiff",
+                   inputs: Sequence[SDVariable], name: Optional[str] = None) -> SDVariable:
+        """lax.while_loop: cond_graph -> scalar bool 'out'; body_graph maps
+        arg0..argN -> out0..outN (or single 'out' for 1-carry loops)."""
+        name = name or self._fresh("while")
+        node = _Node(name, "control", op="while",
+                     inputs=tuple(i.name for i in inputs),
+                     subgraphs={"cond": cond_graph, "body": body_graph})
+        return self._add(node)
+
+    @staticmethod
+    def _subgraph_fn(sub: "SameDiff", outputs: Optional[list] = None):
+        outputs = outputs or ["out"]
+        fn = sub._build_fn(outputs)
+        svars = sub.variables()
+
+        def call(*args):
+            ph = {f"arg{i}": a for i, a in enumerate(args)}
+            outs = fn(svars, ph)
+            return outs[0] if len(outs) == 1 else tuple(outs)
+        return call
 
     # ------------------------------------------------------------ execution
     def _topo(self, targets: list[str]) -> list[str]:
@@ -234,9 +869,40 @@ class SameDiff:
             visit(t)
         return order
 
+    def _node_fn(self, node: _Node) -> Callable:
+        if node.kind == "op":
+            return _OP_IMPLS[node.op](node.attrs)
+        # control nodes
+        if node.op == "cond":
+            tfn = self._subgraph_fn(node.subgraphs["true"])
+            ffn = self._subgraph_fn(node.subgraphs["false"])
+            return lambda pred, *args: jax.lax.cond(
+                jnp.asarray(pred).astype(bool).reshape(()), tfn, ffn, *args)
+        if node.op == "while":
+            n = len(node.inputs)
+            outs = [f"out{i}" for i in range(n)] if n > 1 else ["out"]
+            body_outs = outs if all(o in node.subgraphs["body"]._nodes for o in outs) \
+                else ["out"]
+            cfn = self._subgraph_fn(node.subgraphs["cond"])
+            bfn = self._subgraph_fn(node.subgraphs["body"], body_outs)
+
+            def run(*args):
+                def cond_w(c):
+                    return jnp.asarray(cfn(*c)).astype(bool).reshape(())
+
+                def body_w(c):
+                    r = bfn(*c)
+                    return r if isinstance(r, tuple) else (r,)
+                final = jax.lax.while_loop(cond_w, body_w, tuple(args))
+                return final[0] if len(final) == 1 else final
+            return run
+        raise ValueError(f"unknown control op {node.op}")
+
     def _build_fn(self, targets: list[str]):
         """Compile the graph into fn(variables_dict, placeholders_dict) -> outputs."""
         order = self._topo(targets)
+        fns = {n: self._node_fn(self._nodes[n]) for n in order
+               if self._nodes[n].kind in ("op", "control")}
 
         def fn(variables, placeholders):
             env = {}
@@ -249,7 +915,7 @@ class SameDiff:
                 elif node.kind == "constant":
                     env[n] = node.value
                 else:
-                    env[n] = node.fn(*[env[i] for i in node.inputs])
+                    env[n] = fns[n](*[env[i] for i in node.inputs])
             return [env[t] for t in targets]
 
         return fn
@@ -282,10 +948,23 @@ class SameDiff:
             return {n: g[n] for n in wrt}
         return g
 
+    calculateGradients = grad
+
     # ------------------------------------------------------------- training
     def set_loss(self, loss: str | SDVariable):
         self.loss_name = loss.name if isinstance(loss, SDVariable) else loss
         return self
+
+    def _step_fn(self, updater):
+        fn = self._build_fn([self.loss_name])
+
+        @jax.jit
+        def step(variables, opt_state, i, ph):
+            loss, grads = jax.value_and_grad(lambda vs: fn(vs, ph)[0])(variables)
+            upd, opt_state = updater.update(grads, opt_state, variables, i)
+            new_vars = jax.tree_util.tree_map(lambda v, d: v - d, variables, upd)
+            return new_vars, opt_state, loss
+        return step
 
     def fit(self, updater=None, steps: int = 1, listeners=(), **placeholders) -> float:
         """TrainingSession analog: jitted step = loss + grads + updater apply."""
@@ -294,19 +973,11 @@ class SameDiff:
         if self.loss_name is None:
             raise ValueError("call set_loss() first")
         updater = get_updater(updater) if updater is not None else Sgd(lr=1e-2)
-        fn = self._build_fn([self.loss_name])
         ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
 
         key = ("fit", id(updater))
         if key not in self._jit_cache:
-            @jax.jit
-            def step(variables, opt_state, i, ph):
-                loss, grads = jax.value_and_grad(lambda vs: fn(vs, ph)[0])(variables)
-                upd, opt_state = updater.update(grads, opt_state, variables, i)
-                new_vars = jax.tree_util.tree_map(lambda v, d: v - d, variables, upd)
-                return new_vars, opt_state, loss
-
-            self._jit_cache[key] = step
+            self._jit_cache[key] = self._step_fn(updater)
         step_fn = self._jit_cache[key]
 
         variables = self.variables()
@@ -320,18 +991,106 @@ class SameDiff:
         self.set_variables(variables)
         return float(loss)
 
+    def fit_iterator(self, iterator, feature_ph: str, label_ph: str, updater=None,
+                     epochs: int = 1, listeners=()) -> float:
+        """SameDiff.fit(DataSetIterator) analog: one jitted step reused across
+        every minibatch; updater state persists across batches/epochs."""
+        from deeplearning4j_tpu.optimize.updaters import Sgd, get_updater
+
+        if self.loss_name is None:
+            raise ValueError("call set_loss() first")
+        updater = get_updater(updater) if updater is not None else Sgd(lr=1e-2)
+        step_fn = self._step_fn(updater)
+
+        variables = self.variables()
+        opt_state = updater.init_state(variables)
+        loss, i = np.nan, 0
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                feats, labels = (ds.features, ds.labels) if hasattr(ds, "features") else ds
+                ph = {feature_ph: jnp.asarray(feats), label_ph: jnp.asarray(labels)}
+                variables, opt_state, loss = step_fn(variables, opt_state,
+                                                     jnp.asarray(i, jnp.int32), ph)
+                for lst in listeners:
+                    lst.iteration_done(self, i, 0, float(loss))
+                i += 1
+        self.set_variables(variables)
+        return float(loss)
+
+    def summary(self) -> str:
+        """SameDiff.summary() analog."""
+        lines = [f"{'name':<24}{'kind':<12}{'op':<16}inputs"]
+        for n, d in self._nodes.items():
+            lines.append(f"{n:<24}{d.kind:<12}{d.op or '-':<16}{','.join(d.inputs)}")
+        return "\n".join(lines)
+
     # ---------------------------------------------------------------- serde
+    # Arrays (variables AND constants, at every nesting level) all live in one
+    # npz keyed "<prefix><kind>:<name>", where control-flow sub-graphs extend
+    # the prefix with "<node>/<branch>/" — dtype-exact, no JSON round trip.
+    def _meta(self) -> dict:
+        meta = {}
+        for n, d in self._nodes.items():
+            ent = {"kind": d.kind, "inputs": list(d.inputs)}
+            if d.kind in ("op", "control"):
+                ent["op"] = d.op
+                ent["attrs"] = d.attrs
+            if d.kind == "placeholder" and d.shape:
+                ent["shape"] = list(d.shape)
+            if d.subgraphs:
+                ent["subgraphs"] = {k: g._meta() for k, g in d.subgraphs.items()}
+            meta[n] = ent
+        return meta
+
+    def _collect_arrays(self, prefix: str, out: dict):
+        for n, d in self._nodes.items():
+            if d.kind in ("variable", "constant") and d.value is not None:
+                out[f"{prefix}{d.kind}:{n}"] = np.asarray(d.value)
+            for k, g in d.subgraphs.items():
+                g._collect_arrays(f"{prefix}{n}/{k}/", out)
+
     def save(self, path: str):
-        """FlatBuffers .fb analog: npz of variables + graph metadata pickle-free."""
-        import json as _json
-        import zipfile
-
-        meta = {n: {"kind": d.kind, "inputs": list(d.inputs)}
-                for n, d in self._nodes.items()}
+        """FlatBuffers .fb analog: zip of graph JSON + weights npz; fully
+        reloadable via SameDiff.load (ops referenced by registry name)."""
+        meta = {"nodes": self._meta(), "loss": self.loss_name,
+                "counter": self._counter}
+        arrays: dict = {}
+        self._collect_arrays("", arrays)
         with zipfile.ZipFile(path, "w") as z:
-            z.writestr("graph.json", _json.dumps(meta))
-            import io
-
+            z.writestr("graph.json", json.dumps(meta))
             buf = io.BytesIO()
-            np.savez(buf, **{n: np.asarray(v) for n, v in self.variables().items()})
-            z.writestr("variables.npz", buf.getvalue())
+            np.savez(buf, **arrays)
+            z.writestr("arrays.npz", buf.getvalue())
+
+    @staticmethod
+    def _from_meta(meta: dict, arrays: dict, prefix: str = "") -> "SameDiff":
+        sd = SameDiff()
+        for n, ent in meta.items():
+            kind = ent["kind"]
+            node = _Node(n, kind, inputs=tuple(ent.get("inputs", ())))
+            if kind in ("op", "control"):
+                node.op = ent["op"]
+                node.attrs = ent.get("attrs", {})
+            if kind in ("variable", "constant"):
+                node.value = jnp.asarray(arrays[f"{prefix}{kind}:{n}"])
+            if ent.get("shape"):
+                node.shape = tuple(ent["shape"])
+            for k, sg_meta in ent.get("subgraphs", {}).items():
+                node.subgraphs[k] = SameDiff._from_meta(
+                    sg_meta, arrays, prefix=f"{prefix}{n}/{k}/")
+            sd._nodes[n] = node
+        return sd
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        """Reload a graph saved by save() into an executable SameDiff."""
+        with zipfile.ZipFile(path) as z:
+            meta = json.loads(z.read("graph.json"))
+            with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
+                arrays = {k: npz[k] for k in npz.files}
+        sd = SameDiff._from_meta(meta["nodes"], arrays)
+        sd.loss_name = meta.get("loss")
+        sd._counter = meta.get("counter", len(meta["nodes"]))
+        return sd

@@ -412,6 +412,115 @@ class TFImportedGraph:
 
         return fn
 
+    def to_samediff(self):
+        """Build a SameDiff graph from the imported GraphDef.
+
+        Reference analog: TFGraphMapper.importGraph returns a SameDiff — the
+        imported model is a *graph object* (inspectable, trainable,
+        serializable), not just a closure. Shape/axis argument nodes are
+        baked from Consts into op attrs (the reference does the same when
+        mapping TF's tensor-args onto libnd4j iArgs).
+        """
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.create()
+        handles = {}  # tf node name -> SDVariable
+
+        def const_val(name):
+            ref = self._ref(name)
+            if ref not in self.constants:
+                raise NotImplementedError(
+                    f"to_samediff: node input '{ref}' must be a Const")
+            return np.asarray(self.constants[ref])
+
+        for name in self.order:
+            node = self.nodes[name]
+            ins = [i for i in node.inputs if not i.startswith("^")]
+
+            def x(i):
+                return handles[self._ref(ins[i])]
+
+            if node.op == "Const":
+                handles[name] = sd.constant(self.constants[name], name=name)
+            elif node.op == "Placeholder":
+                handles[name] = sd.placeholder(name)
+            elif node.op in ("Add", "AddV2", "BiasAdd"):
+                handles[name] = sd.add(x(0), x(1), name=name)
+            elif node.op == "Sub":
+                handles[name] = sd.sub(x(0), x(1), name=name)
+            elif node.op == "Mul":
+                handles[name] = sd.mul(x(0), x(1), name=name)
+            elif node.op in ("RealDiv", "Div"):
+                handles[name] = sd.div(x(0), x(1), name=name)
+            elif node.op == "MatMul":
+                a, b = x(0), x(1)
+                ta, tb = node.attr("transpose_a"), node.attr("transpose_b")
+                if ta and ta.b:
+                    a = sd.transpose_(a, [1, 0])
+                if tb and tb.b:
+                    b = sd.transpose_(b, [1, 0])
+                handles[name] = sd.mmul(a, b, name=name)
+            elif node.op == "Relu":
+                handles[name] = sd.relu(x(0), name=name)
+            elif node.op == "Relu6":
+                handles[name] = sd._op("relu6", x(0), name=name)
+            elif node.op == "Sigmoid":
+                handles[name] = sd.sigmoid(x(0), name=name)
+            elif node.op == "Tanh":
+                handles[name] = sd.tanh(x(0), name=name)
+            elif node.op == "Softmax":
+                handles[name] = sd.softmax(x(0), name=name)
+            elif node.op in ("Identity", "StopGradient", "PreventGradient"):
+                handles[name] = sd.identity(x(0), name=name)
+            elif node.op == "Reshape":
+                shape = [int(d) for d in const_val(ins[1]).ravel()]
+                handles[name] = sd.reshape(x(0), shape, name=name)
+            elif node.op == "Squeeze":
+                dims = node.attr("squeeze_dims") or node.attr("axis")
+                axis = list(dims.list_i) if dims and dims.list_i else None
+                handles[name] = sd.squeeze(x(0), axis=axis, name=name)
+            elif node.op == "ExpandDims":
+                handles[name] = sd.expand_dims(
+                    x(0), int(const_val(ins[1]).ravel()[0]), name=name)
+            elif node.op in ("Mean", "Max"):
+                axes = [int(a) for a in const_val(ins[1]).ravel()]
+                keep = node.attr("keep_dims")
+                kd = bool(keep.b) if keep else False
+                fn = sd.mean if node.op == "Mean" else sd.max
+                handles[name] = fn(x(0), axis=axes, keepdims=kd, name=name)
+            elif node.op == "ConcatV2":
+                axis = int(const_val(ins[-1]).ravel()[0])
+                handles[name] = sd.concat([x(i) for i in range(len(ins) - 1)],
+                                          axis=axis, name=name)
+            elif node.op == "Conv2D":
+                strides = node.attr("strides").list_i or [1, 1, 1, 1]
+                pad = _pad_mode(node).lower()
+                handles[name] = sd.conv2d(x(0), x(1),
+                                          strides=tuple(strides[1:3]),
+                                          padding=pad, name=name)
+            elif node.op in ("MaxPool", "AvgPool"):
+                k = node.attr("ksize").list_i
+                s = node.attr("strides").list_i
+                pad = _pad_mode(node).lower()
+                fn = sd.max_pool2d if node.op == "MaxPool" else sd.avg_pool2d
+                handles[name] = fn(x(0), kernel=tuple(k[1:3]),
+                                   strides=tuple(s[1:3]), padding=pad, name=name)
+            elif node.op in ("FusedBatchNorm", "FusedBatchNormV3"):
+                eps = node.attr("epsilon")
+                eps = eps.f if eps and eps.f is not None else 1e-3
+                # TF input order (x, scale, offset, mean, var) -> ours
+                handles[name] = sd.batch_norm(x(0), x(3), x(4), x(1), x(2),
+                                              eps=float(eps), name=name)
+            elif node.op == "Pad":
+                pads = const_val(ins[1]).reshape(-1, 2)
+                handles[name] = sd.pad(x(0), [(int(a), int(b)) for a, b in pads],
+                                       name=name)
+            else:
+                raise NotImplementedError(
+                    f"to_samediff: no SameDiff mapping for TF op '{node.op}' "
+                    f"(node {name})")
+        return sd
+
 
 class TFGraphMapper:
     """importGraph entry point (TFGraphMapper.importGraph analog)."""

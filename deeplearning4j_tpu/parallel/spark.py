@@ -88,9 +88,9 @@ class SparkDl4jMultiLayer:
         degree (the reference re-splits the RDD to batchSizePerWorker per
         executor; here the global SPMD batch is the per-worker size times the
         mesh's data axis)."""
-        global_batch = (self.training_master.batch_size_per_worker
-                        * self._wrapper.mesh.shape["data"])
-        self._wrapper.fit(_RebatchingIterator(data, global_batch),
+        dp = self._wrapper.mesh.shape["data"]
+        global_batch = self.training_master.batch_size_per_worker * dp
+        self._wrapper.fit(_RebatchingIterator(data, global_batch, dp),
                           epochs=epochs)
         return self.network
 
@@ -100,11 +100,17 @@ class SparkDl4jMultiLayer:
 
 class _RebatchingIterator:
     """Re-batches an iterator of DataSets to a fixed global batch size
-    (drop-last semantics, like the reference's RDD repartitioning)."""
+    (like the reference's RDD repartitioning), preserving feature masks.
 
-    def __init__(self, source, batch_size: int):
+    The tail that doesn't fill a whole global batch is NOT dropped: it is
+    flushed truncated down to the largest multiple of the data-parallel
+    degree, so small datasets still train (only examples that can't shard
+    evenly are lost)."""
+
+    def __init__(self, source, batch_size: int, dp: int = 1):
         self._source = source
         self._batch = batch_size
+        self._dp = max(1, dp)
 
     def reset(self):
         if hasattr(self._source, "reset"):
@@ -116,19 +122,37 @@ class _RebatchingIterator:
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.nn.multilayer import _unpack
 
-        feats, labels = [], []
-        have = 0
+        feats, labels, masks = [], [], []
+        have, any_mask = 0, False
+
+        def _cat(n):
+            fx = np.concatenate(feats)
+            fy = np.concatenate(labels)
+            fm = np.concatenate(masks) if any_mask else None
+            return (DataSet(fx[:n], fy[:n],
+                            None if fm is None else fm[:n]),
+                    fx[n:], fy[n:], None if fm is None else fm[n:])
+
         for ds in self._source:
-            x, y, _ = _unpack(ds)
+            x, y, mask = _unpack(ds)
             feats.append(np.asarray(x))
             labels.append(np.asarray(y))
+            if mask is not None:
+                any_mask = True
+                masks.append(np.asarray(mask))
+            elif any_mask:
+                raise ValueError("mixed masked/unmasked DataSets in one stream")
             have += feats[-1].shape[0]
             while have >= self._batch:
-                fx = np.concatenate(feats)
-                fy = np.concatenate(labels)
-                yield DataSet(fx[:self._batch], fy[:self._batch])
-                feats, labels = [fx[self._batch:]], [fy[self._batch:]]
-                have = feats[0].shape[0]
+                out, fx, fy, fm = _cat(self._batch)
+                yield out
+                feats, labels = [fx], [fy]
+                masks = [fm] if fm is not None else []
+                have = fx.shape[0]
+        tail = (have // self._dp) * self._dp
+        if tail:
+            out, _, _, _ = _cat(tail)
+            yield out
 
 
 class SparkComputationGraph(SparkDl4jMultiLayer):

@@ -232,3 +232,53 @@ class TestScalarFieldTensors:
         g = self._tensor_scalar_fields(7, payload, 3, [4])
         arr = TFGraphMapper.import_graph(g).constants["c"]
         np.testing.assert_array_equal(arr, np.full(4, 9, np.int32))
+
+
+class TestToSameDiff:
+    def test_mlp_to_samediff_matches_direct(self, rng):
+        W = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(3,)).astype(np.float32)
+        g = graph_def(
+            node("x", "Placeholder"),
+            node("W", "Const", value=_attr("value", t=W)),
+            node("b", "Const", value=_attr("value", t=b)),
+            node("mm", "MatMul", ["x", "W"]),
+            node("ba", "BiasAdd", ["mm", "b"]),
+            node("relu", "Relu", ["ba"]),
+            node("probs", "Softmax", ["relu"]),
+        )
+        imported = TFGraphMapper.import_graph(g)
+        sd = imported.to_samediff()
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        direct = np.asarray(imported.output({"x": x}, ["probs"]))
+        via_sd = np.asarray(sd.output("probs", x=x))
+        np.testing.assert_allclose(via_sd, direct, rtol=1e-5, atol=1e-6)
+
+    def test_conv_graph_to_samediff_and_save(self, rng, tmp_path):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        K = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)
+        g = graph_def(
+            node("x", "Placeholder"),
+            node("K", "Const", value=_attr("value", t=K)),
+            node("conv", "Conv2D", ["x", "K"],
+                 strides=_attr("strides", li=[1, 1, 1, 1]),
+                 padding=_attr("padding", s="SAME")),
+            node("relu", "Relu", ["conv"]),
+            node("pool", "MaxPool", ["relu"],
+                 ksize=_attr("ksize", li=[1, 2, 2, 1]),
+                 strides=_attr("strides", li=[1, 2, 2, 1]),
+                 padding=_attr("padding", s="VALID")),
+        )
+        imported = TFGraphMapper.import_graph(g)
+        sd = imported.to_samediff()
+        x = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)
+        want = np.asarray(imported.output({"x": x}, ["pool"]))
+        np.testing.assert_allclose(np.asarray(sd.output("pool", x=x)), want,
+                                   rtol=1e-4, atol=1e-5)
+        # imported graph serializes like any other SameDiff (.fb analog)
+        p = str(tmp_path / "imported.sdz")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        np.testing.assert_allclose(np.asarray(sd2.output("pool", x=x)), want,
+                                   rtol=1e-4, atol=1e-5)
