@@ -395,6 +395,12 @@ def _b_identity(attrs):
     return lambda a: a
 
 
+@register_sd_op("tuple_get")
+def _b_tuple_get(attrs):
+    i = attrs["index"]
+    return lambda t: t[i]
+
+
 @register_sd_op("pad")
 def _b_pad(attrs):
     pads = [tuple(p) for p in attrs["paddings"]]
@@ -832,14 +838,22 @@ class SameDiff:
         return self._add(node)
 
     def while_loop(self, cond_graph: "SameDiff", body_graph: "SameDiff",
-                   inputs: Sequence[SDVariable], name: Optional[str] = None) -> SDVariable:
+                   inputs: Sequence[SDVariable], name: Optional[str] = None):
         """lax.while_loop: cond_graph -> scalar bool 'out'; body_graph maps
-        arg0..argN -> out0..outN (or single 'out' for 1-carry loops)."""
+        arg0..argN -> out0..outN (or single 'out' for 1-carry loops).
+
+        Returns one SDVariable for a single carry, else a list of
+        SDVariables — one per carry (tuple_get selector nodes)."""
         name = name or self._fresh("while")
         node = _Node(name, "control", op="while",
                      inputs=tuple(i.name for i in inputs),
                      subgraphs={"cond": cond_graph, "body": body_graph})
-        return self._add(node)
+        var = self._add(node)
+        if len(inputs) == 1:
+            return var
+        return [self._op("tuple_get", var, attrs={"index": i},
+                         name=f"{name}_out{i}")
+                for i in range(len(inputs))]
 
     @staticmethod
     def _subgraph_fn(sub: "SameDiff", outputs: Optional[list] = None):

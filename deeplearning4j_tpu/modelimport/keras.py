@@ -23,10 +23,13 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers import (
-    ActivationLayer, BatchNormalizationLayer, Convolution1DLayer, ConvolutionLayer,
-    DenseLayer, DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
-    GRULayer, LSTMLayer, OutputLayer, SimpleRnnLayer, SubsamplingLayer,
-    ZeroPadding2DLayer,
+    ActivationLayer, BatchNormalizationLayer, BidirectionalLayer,
+    Convolution1DLayer, ConvolutionLayer, Cropping2DLayer,
+    Deconvolution2DLayer, DenseLayer, DepthwiseConvolution2DLayer,
+    DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer, GRULayer,
+    LayerNormalizationLayer, LSTMLayer, OutputLayer,
+    SeparableConvolution2DLayer, SimpleRnnLayer, Subsampling1DLayer,
+    SubsamplingLayer, Upsampling2DLayer, ZeroPadding2DLayer,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.optimize.updaters import Adam
@@ -97,6 +100,63 @@ class KerasLayerMapper:
             return LastTimeStepLayer(underlying=inner)
         if cls == "Embedding":
             return EmbeddingSequenceLayer(n_in=cfg["input_dim"], n_out=cfg["output_dim"])
+        if cls == "SeparableConv2D":
+            return SeparableConvolution2DLayer(
+                n_out=cfg["filters"], kernel=tuple(cfg["kernel_size"]),
+                strides=tuple(cfg.get("strides", (1, 1))), padding=_pad(cfg),
+                depth_multiplier=cfg.get("depth_multiplier", 1), activation=act,
+                has_bias=cfg.get("use_bias", True))
+        if cls == "DepthwiseConv2D":
+            return DepthwiseConvolution2DLayer(
+                kernel=tuple(cfg["kernel_size"]),
+                strides=tuple(cfg.get("strides", (1, 1))), padding=_pad(cfg),
+                depth_multiplier=cfg.get("depth_multiplier", 1), activation=act,
+                has_bias=cfg.get("use_bias", True))
+        if cls == "Conv2DTranspose":
+            return Deconvolution2DLayer(
+                n_out=cfg["filters"], kernel=tuple(cfg["kernel_size"]),
+                strides=tuple(cfg.get("strides", (1, 1))), padding=_pad(cfg),
+                activation=act, has_bias=cfg.get("use_bias", True))
+        if cls == "UpSampling2D":
+            return Upsampling2DLayer(size=tuple(cfg.get("size", (2, 2))))
+        if cls == "Cropping2D":
+            c = cfg["cropping"]
+            return Cropping2DLayer(crop=tuple(tuple(q) for q in c))
+        if cls == "LayerNormalization":
+            return LayerNormalizationLayer(eps=cfg.get("epsilon", 1e-3))
+        if cls == "LeakyReLU":
+            return ActivationLayer(activation=f"leakyrelu:{cfg.get('alpha', 0.3)}")
+        if cls == "ELU":
+            return ActivationLayer(activation=f"elu:{cfg.get('alpha', 1.0)}")
+        if cls == "ReLU":
+            if cfg.get("max_value") is not None:
+                return ActivationLayer(activation=f"relumax:{cfg['max_value']}")
+            ns = cfg.get("negative_slope", 0.0)
+            if ns:
+                return ActivationLayer(activation=f"leakyrelu:{ns}")
+            return ActivationLayer(activation="relu")
+        if cls in ("MaxPooling1D", "AveragePooling1D"):
+            ps = cfg["pool_size"]
+            ps = ps[0] if isinstance(ps, (list, tuple)) else ps
+            st = cfg.get("strides")
+            st = st[0] if isinstance(st, (list, tuple)) else st
+            return Subsampling1DLayer(
+                kernel=ps, strides=st,
+                pooling_type="max" if cls.startswith("Max") else "avg")
+        if cls in ("SpatialDropout1D", "SpatialDropout2D"):
+            return DropoutLayer(rate=cfg["rate"])
+        if cls == "Bidirectional":
+            inner_cfg = cfg["layer"]
+            inner = self.map(inner_cfg["class_name"], inner_cfg["config"])
+            mode = {"concat": "concat", "sum": "add", "mul": "mul",
+                    "ave": "average", None: "concat"}[cfg.get("merge_mode", "concat")]
+            from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+
+            if isinstance(inner, LastTimeStepLayer):
+                # Keras wraps merge around full sequences, then slices
+                return LastTimeStepLayer(
+                    underlying=BidirectionalLayer(fwd=inner.underlying, mode=mode))
+            return BidirectionalLayer(fwd=inner, mode=mode)
         if cls in ("InputLayer",):
             return None
         raise ValueError(f"unsupported Keras layer type: {cls}")
@@ -198,43 +258,80 @@ class KerasModelImport:
             p = model.params[li]
             if isinstance(layer, LastTimeStepLayer):
                 layer = layer.underlying  # params delegate to the wrapped RNN
-            if isinstance(layer, (DenseLayer,)) and "W" in p:
+            if isinstance(layer, BidirectionalLayer):
+                KerasModelImport._load_bidirectional(layer, p, ws)
+            elif isinstance(layer, (DenseLayer,)) and "W" in p:
                 p["W"] = jnp.asarray(ws[0])
+                if layer.has_bias and len(ws) > 1:
+                    p["b"] = jnp.asarray(ws[1])
+            elif isinstance(layer, SeparableConvolution2DLayer):
+                p["dW"] = jnp.asarray(ws[0])  # (kh,kw,cin,mult)
+                p["pW"] = jnp.asarray(ws[1])  # (1,1,cin*mult,filters)
+                if layer.has_bias and len(ws) > 2:
+                    p["b"] = jnp.asarray(ws[2])
+            elif isinstance(layer, DepthwiseConvolution2DLayer):
+                p["W"] = jnp.asarray(ws[0])
+                if layer.has_bias and len(ws) > 1:
+                    p["b"] = jnp.asarray(ws[1])
+            elif isinstance(layer, Deconvolution2DLayer):
+                # keras Conv2DTranspose kernel is (kh, kw, out, in) with
+                # scatter (flipped) semantics; ours is lax.conv_transpose
+                # HWIO without the flip -> transpose dims + flip spatially
+                p["W"] = jnp.asarray(
+                    np.transpose(ws[0], (0, 1, 3, 2))[::-1, ::-1].copy())
                 if layer.has_bias and len(ws) > 1:
                     p["b"] = jnp.asarray(ws[1])
             elif isinstance(layer, ConvolutionLayer):
                 p["W"] = jnp.asarray(ws[0])  # keras HWIO == ours
                 if layer.has_bias and len(ws) > 1:
                     p["b"] = jnp.asarray(ws[1])
+            elif isinstance(layer, LayerNormalizationLayer):
+                p["gamma"] = jnp.asarray(ws[0])
+                if len(ws) > 1:
+                    p["beta"] = jnp.asarray(ws[1])
             elif isinstance(layer, BatchNormalizationLayer):
                 gamma, beta, mean, var = ws
                 p["gamma"] = jnp.asarray(gamma)
                 p["beta"] = jnp.asarray(beta)
                 model.state[li]["mean"] = jnp.asarray(mean)
                 model.state[li]["var"] = jnp.asarray(var)
-            elif isinstance(layer, LSTMLayer):
-                kernel, rec, bias = ws
-                H = layer.n_out
-                # keras gates i,f,c,o -> ours i,f,o,g(c)
-                perm = np.concatenate([np.arange(0, 2 * H),          # i, f
-                                       np.arange(3 * H, 4 * H),      # o
-                                       np.arange(2 * H, 3 * H)])     # c -> g
-                p["W"] = jnp.asarray(kernel[:, perm])
-                p["RW"] = jnp.asarray(rec[:, perm])
-                p["b"] = jnp.asarray(bias[perm])
-            elif isinstance(layer, GRULayer):
-                kernel, rec, bias = ws
-                # keras gates z,r,h -> ours r,z,n
-                H = layer.n_out
-                perm = np.concatenate([np.arange(H, 2 * H), np.arange(0, H),
-                                       np.arange(2 * H, 3 * H)])
-                p["W"] = jnp.asarray(kernel[:, perm])
-                p["RW"] = jnp.asarray(rec[:, perm])
-                p["b"] = jnp.asarray(bias.reshape(-1, 3 * H).sum(0)[perm])
+            elif isinstance(layer, (LSTMLayer, GRULayer, SimpleRnnLayer)):
+                KerasModelImport._load_rnn(layer, p, ws)
             elif isinstance(layer, EmbeddingSequenceLayer):
                 p["W"] = jnp.asarray(ws[0])
-            elif isinstance(layer, SimpleRnnLayer):
-                kernel, rec, bias = ws
-                p["W"] = jnp.asarray(kernel)
-                p["RW"] = jnp.asarray(rec)
-                p["b"] = jnp.asarray(bias)
+
+    @staticmethod
+    def _load_rnn(layer, p, ws):
+        """Copy one RNN cell's (kernel, recurrent, bias) with gate reorder."""
+        import jax.numpy as jnp
+
+        kernel, rec, bias = ws
+        if isinstance(layer, LSTMLayer):
+            H = layer.n_out
+            # keras gates i,f,c,o -> ours i,f,o,g(c)
+            perm = np.concatenate([np.arange(0, 2 * H),          # i, f
+                                   np.arange(3 * H, 4 * H),      # o
+                                   np.arange(2 * H, 3 * H)])     # c -> g
+            p["W"] = jnp.asarray(kernel[:, perm])
+            p["RW"] = jnp.asarray(rec[:, perm])
+            p["b"] = jnp.asarray(np.asarray(bias).reshape(-1, 4 * H).sum(0)[perm])
+        elif isinstance(layer, GRULayer):
+            # keras gates z,r,h -> ours r,z,n
+            H = layer.n_out
+            perm = np.concatenate([np.arange(H, 2 * H), np.arange(0, H),
+                                   np.arange(2 * H, 3 * H)])
+            p["W"] = jnp.asarray(kernel[:, perm])
+            p["RW"] = jnp.asarray(rec[:, perm])
+            p["b"] = jnp.asarray(np.asarray(bias).reshape(-1, 3 * H).sum(0)[perm])
+        else:
+            p["W"] = jnp.asarray(kernel)
+            p["RW"] = jnp.asarray(rec)
+            p["b"] = jnp.asarray(bias)
+
+    @staticmethod
+    def _load_bidirectional(layer, p, ws):
+        """Keras Bidirectional stores forward weights then backward weights."""
+        inner = layer.fwd
+        half = len(ws) // 2
+        KerasModelImport._load_rnn(inner, p["fwd"], ws[:half])
+        KerasModelImport._load_rnn(inner, p["bwd"], ws[half:])

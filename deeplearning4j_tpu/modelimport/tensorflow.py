@@ -352,7 +352,7 @@ def _pad_op(node, xs):
 def _fused_bn(node, xs):
     x, scale, offset, mean, var = xs[:5]
     eps = node.attr("epsilon")
-    eps = eps.f if eps and eps.f is not None else 1e-3
+    eps = eps.f if eps and eps.f is not None else 1e-4  # TF op default
     inv = scale / jnp.sqrt(var + eps)
     return x * inv + (offset - mean * inv)
 
@@ -507,7 +507,7 @@ class TFImportedGraph:
                                    strides=tuple(s[1:3]), padding=pad, name=name)
             elif node.op in ("FusedBatchNorm", "FusedBatchNormV3"):
                 eps = node.attr("epsilon")
-                eps = eps.f if eps and eps.f is not None else 1e-3
+                eps = eps.f if eps and eps.f is not None else 1e-4  # TF op default
                 # TF input order (x, scale, offset, mean, var) -> ours
                 handles[name] = sd.batch_norm(x(0), x(3), x(4), x(1), x(2),
                                               eps=float(eps), name=name)

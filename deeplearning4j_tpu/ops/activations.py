@@ -56,10 +56,24 @@ ACTIVATIONS: dict[str, Callable] = {
 }
 
 
+_PARAMETRIC = {
+    "leakyrelu": lambda a: lambda x: jax.nn.leaky_relu(x, negative_slope=a),
+    "elu": lambda a: lambda x: jax.nn.elu(x, alpha=a),
+    "relumax": lambda a: lambda x: jnp.clip(x, 0.0, a),
+    "thresholdedrelu": lambda a: lambda x: jnp.where(x > a, x, 0.0),
+}
+
+
 def get_activation(name_or_fn) -> Callable:
     if callable(name_or_fn):
         return name_or_fn
     key = str(name_or_fn).lower().replace("_", "")
+    if ":" in key:
+        # parameterized, JSON-serializable form: "leakyrelu:0.3", "elu:0.5"
+        base, _, arg = key.partition(":")
+        if base not in _PARAMETRIC:
+            raise ValueError(f"activation '{base}' does not take a parameter")
+        return _PARAMETRIC[base](float(arg))
     if key not in ACTIVATIONS:
         raise ValueError(f"unknown activation '{name_or_fn}'; known: {sorted(ACTIVATIONS)}")
     return ACTIVATIONS[key]

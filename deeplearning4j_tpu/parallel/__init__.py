@@ -24,10 +24,14 @@ from deeplearning4j_tpu.parallel.spark import (
 from deeplearning4j_tpu.parallel.distributed import (
     FaultTolerantTrainer, initialize_distributed,
 )
+from deeplearning4j_tpu.parallel.sequence import (
+    ring_attention, sequence_parallel_encoder, ulysses_attention,
+)
 
 __all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference", "TensorParallel",
            "GPipe", "pipeline_train_step", "stack_stage_params",
            "init_moe_params", "moe_param_specs", "place_moe_params",
            "switch_moe", "FaultTolerantTrainer", "initialize_distributed",
            "SparkDl4jMultiLayer", "SparkComputationGraph",
-           "ParameterAveragingTrainingMaster", "SharedTrainingMaster"]
+           "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
+           "ring_attention", "ulysses_attention", "sequence_parallel_encoder"]

@@ -115,3 +115,70 @@ def ulysses_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
         out_specs=P(None, None, axis, None),
     )
     return fn(q, k, v)
+
+
+def _ulysses_causal_guard(n_heads, mesh, axis):
+    size = mesh.shape[axis]
+    if n_heads % size:
+        raise ValueError(f"ulysses needs n_heads ({n_heads}) divisible by "
+                         f"mesh axis '{axis}' size ({size})")
+
+
+def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
+                              axis: str = "seq", causal: bool = False,
+                              impl: str = "ring", activation: str = "gelu"):
+    """TransformerEncoderLayer forward with activations sequence-sharded.
+
+    Takes the SAME param dict as nn.layers.attention.TransformerEncoderLayer
+    (pre-norm form) and produces identical outputs, but every activation is
+    sharded [B, T/n, D] over the mesh's ``axis``: LN, QKV/output projections
+    and the MLP are per-token (no communication), and only the attention core
+    communicates — ppermute KV rotation (impl="ring") or head all-to-all
+    (impl="ulysses"). This is the long-context training path the reference
+    lacks entirely (its only tool is single-device truncated BPTT,
+    MultiLayerConfiguration.tBPTTLength — SURVEY.md §5).
+
+    x: [B, T, D] with T divisible by the axis size. Returns [B, T, D].
+    """
+    from deeplearning4j_tpu.nn.layers.base import resolve_activation
+
+    act = resolve_activation(activation)
+    if impl == "ulysses":
+        _ulysses_causal_guard(n_heads, mesh, axis)
+    elif impl != "ring":
+        raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+
+    def _ln(h, g, b):
+        m = h.mean(-1, keepdims=True)
+        v = h.var(-1, keepdims=True)
+        return (h - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+    def block(p, xl):
+        B, Tl, D = xl.shape
+        dh = D // n_heads
+        scale = 1.0 / (dh ** 0.5)
+
+        h = _ln(xl, p["ln1_g"], p["ln1_b"])
+        # per-token projections on the local shard
+        def heads(w, b):
+            y = h @ w + b
+            return y.reshape(B, Tl, n_heads, dh).transpose(0, 2, 1, 3)
+
+        q = heads(p["Wq"], p["bq"])
+        k = heads(p["Wk"], p["bk"])
+        v = heads(p["Wv"], p["bv"])
+        local = _ring_attention_local if impl == "ring" else _ulysses_local
+        a = local(q, k, v, axis=axis, causal=causal, scale=scale)
+        a = a.transpose(0, 2, 1, 3).reshape(B, Tl, D) @ p["Wo"] + p["bo"]
+        xl = xl + a
+
+        h = _ln(xl, p["ln2_g"], p["ln2_b"])
+        m = act(h @ p["W1"] + p["b1"]) @ p["W2"] + p["b2"]
+        return xl + m
+
+    fn = shard_map(
+        block, mesh=mesh,
+        in_specs=(P(), P(None, axis, None)),
+        out_specs=P(None, axis, None),
+    )
+    return fn(params, x)

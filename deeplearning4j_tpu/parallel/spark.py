@@ -123,7 +123,7 @@ class _RebatchingIterator:
         from deeplearning4j_tpu.nn.multilayer import _unpack
 
         feats, labels, masks = [], [], []
-        have, any_mask = 0, False
+        have, any_mask, any_unmasked = 0, False, False
 
         def _cat(n):
             fx = np.concatenate(feats)
@@ -140,7 +140,9 @@ class _RebatchingIterator:
             if mask is not None:
                 any_mask = True
                 masks.append(np.asarray(mask))
-            elif any_mask:
+            else:
+                any_unmasked = True
+            if any_mask and any_unmasked:
                 raise ValueError("mixed masked/unmasked DataSets in one stream")
             have += feats[-1].shape[0]
             while have >= self._batch:

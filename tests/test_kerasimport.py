@@ -130,3 +130,153 @@ class TestKerasDense:
         e = np.exp(logits - logits.max(-1, keepdims=True))
         np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
                                    rtol=1e-4, atol=1e-5)
+
+
+def _np_lstm(x, kernel, rec, bias, H):
+    """numpy LSTM with KERAS gate order (i, f, c, o), full sequence out."""
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    B, T, _ = x.shape
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    out = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        z = x[:, t] @ kernel + h @ rec + bias
+        i = sig(z[:, :H]); f = sig(z[:, H:2 * H])
+        cc = np.tanh(z[:, 2 * H:3 * H]); o = sig(z[:, 3 * H:])
+        c = f * c + i * cc
+        h = o * np.tanh(c)
+        out[:, t] = h
+    return out
+
+
+class TestKerasWideLayers:
+    def test_separable_and_depthwise_conv(self, tmp_path, rng):
+        C, M, F = 3, 2, 5
+        dk = rng.normal(size=(3, 3, C, M)).astype(np.float32) * 0.3
+        pk = rng.normal(size=(1, 1, C * M, F)).astype(np.float32) * 0.3
+        sb = rng.normal(size=(F,)).astype(np.float32) * 0.1
+        layers = [
+            {"class_name": "SeparableConv2D",
+             "config": {"name": "sep", "filters": F, "kernel_size": [3, 3],
+                        "padding": "same", "activation": "relu",
+                        "batch_input_shape": [None, 8, 8, C]}},
+        ]
+        path = _write_keras_h5(tmp_path / "sep.h5", layers, {
+            "sep": [("depthwise_kernel:0", dk), ("pointwise_kernel:0", pk),
+                    ("bias:0", sb)],
+        })
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(2, 8, 8, C)).astype(np.float32)
+        out = np.asarray(model.output(x))
+
+        import jax
+
+        dw = jax.lax.conv_general_dilated(
+            x, dk.reshape(3, 3, 1, C * M), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=C)
+        ref = jax.lax.conv_general_dilated(
+            np.asarray(dw), pk, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + sb
+        np.testing.assert_allclose(out, np.maximum(np.asarray(ref), 0),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_conv2d_transpose_kernel_layout(self, tmp_path, rng):
+        C, F = 2, 3
+        k = rng.normal(size=(2, 2, F, C)).astype(np.float32) * 0.5  # keras (kh,kw,out,in)
+        layers = [
+            {"class_name": "Conv2DTranspose",
+             "config": {"name": "up", "filters": F, "kernel_size": [2, 2],
+                        "strides": [2, 2], "padding": "valid",
+                        "activation": "linear", "use_bias": False,
+                        "batch_input_shape": [None, 4, 4, C]}},
+        ]
+        path = _write_keras_h5(tmp_path / "deconv.h5", layers, {
+            "up": [("kernel:0", k)],
+        })
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(1, 4, 4, C)).astype(np.float32)
+        out = np.asarray(model.output(x))
+        assert out.shape == (1, 8, 8, F)
+        # stride-2 2x2 VALID deconv == each input pixel scaled by the kernel
+        ref = np.zeros((1, 8, 8, F), np.float32)
+        for i in range(4):
+            for j in range(4):
+                for a in range(2):
+                    for b in range(2):
+                        ref[0, 2 * i + a, 2 * j + b] += x[0, i, j] @ k[a, b].T
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_upsample_crop_layernorm(self, tmp_path, rng):
+        g = rng.random(4).astype(np.float32) + 0.5
+        b = rng.normal(size=4).astype(np.float32)
+        layers = [
+            {"class_name": "UpSampling2D",
+             "config": {"name": "ups", "size": [2, 2],
+                        "batch_input_shape": [None, 3, 3, 4]}},
+            {"class_name": "Cropping2D",
+             "config": {"name": "crop", "cropping": [[1, 1], [0, 2]]}},
+            {"class_name": "LayerNormalization",
+             "config": {"name": "ln", "epsilon": 1e-3}},
+        ]
+        path = _write_keras_h5(tmp_path / "ucl.h5", layers, {
+            "ln": [("gamma:0", g), ("beta:0", b)],
+        })
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(2, 3, 3, 4)).astype(np.float32)
+        out = np.asarray(model.output(x))
+        up = x.repeat(2, axis=1).repeat(2, axis=2)
+        crop = up[:, 1:5, 0:4, :]
+        mu = crop.mean(-1, keepdims=True)
+        var = crop.var(-1, keepdims=True)
+        ref = (crop - mu) / np.sqrt(var + 1e-3) * g + b
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("merge_mode", ["concat", "sum"])
+    def test_bidirectional_lstm(self, tmp_path, rng, merge_mode):
+        F, H, T = 3, 4, 6
+        fk = rng.normal(size=(F, 4 * H)).astype(np.float32) * 0.3
+        fr = rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.3
+        fb = rng.normal(size=(4 * H,)).astype(np.float32) * 0.1
+        bk = rng.normal(size=(F, 4 * H)).astype(np.float32) * 0.3
+        br = rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.3
+        bb = rng.normal(size=(4 * H,)).astype(np.float32) * 0.1
+        layers = [
+            {"class_name": "Bidirectional",
+             "config": {"name": "bidi", "merge_mode": merge_mode,
+                        "batch_input_shape": [None, T, F],
+                        "layer": {"class_name": "LSTM",
+                                  "config": {"name": "lstm", "units": H,
+                                             "return_sequences": True}}}},
+        ]
+        path = _write_keras_h5(tmp_path / "bidi.h5", layers, {
+            "bidi": [("forward/kernel:0", fk), ("forward/recurrent_kernel:0", fr),
+                     ("forward/bias:0", fb), ("backward/kernel:0", bk),
+                     ("backward/recurrent_kernel:0", br), ("backward/bias:0", bb)],
+        })
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(2, T, F)).astype(np.float32)
+        out = np.asarray(model.output(x))
+
+        yf = _np_lstm(x, fk, fr, fb, H)
+        yb = _np_lstm(x[:, ::-1], bk, br, bb, H)[:, ::-1]
+        ref = np.concatenate([yf, yb], -1) if merge_mode == "concat" else yf + yb
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_pool1d_and_leakyrelu(self, tmp_path, rng):
+        layers = [
+            {"class_name": "MaxPooling1D",
+             "config": {"name": "mp", "pool_size": [2], "strides": [2],
+                        "batch_input_shape": [None, 8, 3]}},
+            {"class_name": "LeakyReLU",
+             "config": {"name": "lr", "alpha": 0.3}},
+        ]
+        path = _write_keras_h5(tmp_path / "p1d.h5", layers, {})
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(2, 8, 3)).astype(np.float32)
+        out = np.asarray(model.output(x))
+        pooled = x.reshape(2, 4, 2, 3).max(axis=2)
+        # configured keras alpha must be honored
+        ref = np.where(pooled > 0, pooled, pooled * 0.3)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)

@@ -253,3 +253,45 @@ def test_reversed_slice():
     xv = np.arange(5, dtype=np.float32)
     np.testing.assert_allclose(np.asarray(x[::-1].eval(x=xv)), xv[::-1])
     np.testing.assert_allclose(np.asarray(x[3:0:-1].eval(x=xv)), xv[3:0:-1])
+
+
+def test_while_loop_multi_carry():
+    # Fibonacci-ish: (a, b, i) -> (b, a+b, i+1) while i < 5
+    cg = SameDiff.create()
+    cg.placeholder("arg0"); cg.placeholder("arg1")
+    i = cg.placeholder("arg2")
+    cg.lt(i, 5.0, name="out")
+
+    bg = SameDiff.create()
+    a = bg.placeholder("arg0")
+    b = bg.placeholder("arg1")
+    j = bg.placeholder("arg2")
+    bg.identity(b, name="out0")
+    bg.add(a, b, name="out1")
+    bg.add(j, 1.0, name="out2")
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    n = sd.placeholder("n")
+    outs = sd.while_loop(cg, bg, [x, y, n])
+    assert len(outs) == 3
+    a_f, b_f, i_f = (float(o.eval(x=np.float32(0.0), y=np.float32(1.0),
+                                  n=np.float32(0.0))) for o in outs)
+    assert (a_f, b_f, i_f) == (5.0, 8.0, 5.0)
+    # downstream ops on a selected carry work
+    doubled = sd.mul(outs[1], 2.0)
+    assert float(doubled.eval(x=np.float32(0.0), y=np.float32(1.0),
+                              n=np.float32(0.0))) == 16.0
+
+
+def test_parametric_activations():
+    from deeplearning4j_tpu.ops.activations import get_activation
+
+    x = np.array([-2.0, -0.5, 0.5, 8.0], np.float32)
+    np.testing.assert_allclose(np.asarray(get_activation("leakyrelu:0.3")(x)),
+                               np.where(x > 0, x, 0.3 * x), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(get_activation("relumax:6.0")(x)),
+                               np.clip(x, 0, 6), rtol=1e-6)
+    with pytest.raises(ValueError):
+        get_activation("softmax:2.0")
