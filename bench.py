@@ -30,6 +30,12 @@ def _enable_compile_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception:
         pass  # older jax without the knobs
+    try:
+        from deeplearning4j_tpu.native import trim_compile_cache
+
+        trim_compile_cache(cache_dir, cap_bytes=4 << 30)  # LRU cap, native
+    except Exception:
+        pass
 
 
 def _measure(step_fn, args, loss_index, warmup=2, iters=50):

@@ -4,6 +4,6 @@ Reference analog: org.nd4j.autodiff.** (SameDiff define-then-run graphs,
 validation.OpValidation, GradCheckUtil).
 """
 
-from deeplearning4j_tpu.autodiff.gradcheck import grad_check, grad_check_model
+from deeplearning4j_tpu.autodiff.gradcheck import grad_check, grad_check_graph, grad_check_model
 
-__all__ = ["grad_check", "grad_check_model"]
+__all__ = ["grad_check", "grad_check_graph", "grad_check_model"]

@@ -99,3 +99,30 @@ def grad_check_model(model, x, y, mask=None, **kw) -> dict:
     # argnums restricts the checked gradients to the parameter leaves.
     return grad_check(loss_of, *leaves, np.asarray(x), np.asarray(y),
                       argnums=tuple(range(len(leaves))), **kw)
+
+
+def grad_check_graph(graph, inputs: dict, labels: dict, masks=None, **kw) -> dict:
+    """Gradient-check a ComputationGraph's loss wrt every parameter leaf.
+
+    Reference analog: GradientCheckTestsComputationGraph — same central
+    checker run over DAG topologies (merge/elementwise vertices, multi-input,
+    multi-output)."""
+    params = graph.params
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    n_in = len(inputs)
+    in_names = list(inputs)
+    lab_names = list(labels)
+
+    def loss_of(*args):
+        leaf_args = args[: len(leaves)]
+        xs = args[len(leaves) : len(leaves) + n_in]
+        ys = args[len(leaves) + n_in :]
+        p = jax.tree_util.tree_unflatten(treedef, list(leaf_args))
+        loss, _ = graph._loss(p, graph.state, dict(zip(in_names, xs)),
+                              dict(zip(lab_names, ys)), None, masks)
+        return loss
+
+    trailing = [np.asarray(inputs[k]) for k in in_names] + \
+               [np.asarray(labels[k]) for k in lab_names]
+    return grad_check(loss_of, *leaves, *trailing,
+                      argnums=tuple(range(len(leaves))), **kw)

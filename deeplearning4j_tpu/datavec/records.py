@@ -116,6 +116,23 @@ class CSVRecordReader(RecordReader):
         self._rows = [[self._parse(v) for v in r] for r in rows[self._skip:] if r]
         self._pos = 0
 
+    def numeric_array(self):
+        """Whole file as a float32 [rows, cols] array.
+
+        Fast path: the multi-threaded native CSV parser (native/
+        dl4jtpu_native.cpp dl4j_csv_parse — the reference keeps its ETL hot
+        path native the same way); falls back to the Python rows."""
+        if self._path is not None and self._skip in (0, 1):
+            from deeplearning4j_tpu.native import native_csv_parse
+
+            arr = native_csv_parse(self._path, delimiter=self._delim,
+                                   skip_header=self._skip == 1)
+            if arr is not None:
+                return arr
+        if self._rows is None:
+            self.reset()
+        return np.asarray(self._rows, dtype=np.float32)
+
     def has_next(self):
         if self._rows is None:
             self.reset()

@@ -8,9 +8,12 @@ plain C ABI + ctypes). Every entry point has a pure-Python fallback so the
 framework works where no toolchain exists.
 """
 
-from deeplearning4j_tpu.native.lib import load_native_lib, native_available
+from deeplearning4j_tpu.native.lib import (
+    load_native_lib, native_available, native_csv_parse, trim_compile_cache,
+)
 from deeplearning4j_tpu.native.workspace import Workspace
 from deeplearning4j_tpu.native.pipeline import NativeDataSetIterator, write_binary_dataset
 
 __all__ = ["load_native_lib", "native_available", "Workspace",
-           "NativeDataSetIterator", "write_binary_dataset"]
+           "NativeDataSetIterator", "write_binary_dataset",
+           "native_csv_parse", "trim_compile_cache"]

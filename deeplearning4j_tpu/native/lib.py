@@ -60,7 +60,55 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_pipe_batches_per_epoch.restype = c.c_long
     lib.dl4j_pipe_batches_per_epoch.argtypes = [c.c_void_p]
     lib.dl4j_pipe_destroy.argtypes = [c.c_void_p]
+
+    lib.dl4j_csv_parse.restype = c.c_void_p
+    lib.dl4j_csv_parse.argtypes = [c.c_char_p, c.c_char, c.c_int, c.c_int]
+    lib.dl4j_csv_rows.restype = c.c_long
+    lib.dl4j_csv_rows.argtypes = [c.c_void_p]
+    lib.dl4j_csv_cols.restype = c.c_long
+    lib.dl4j_csv_cols.argtypes = [c.c_void_p]
+    lib.dl4j_csv_copy.argtypes = [c.c_void_p, c.POINTER(c.c_float)]
+    lib.dl4j_csv_free.argtypes = [c.c_void_p]
+
+    lib.dl4j_cache_trim.restype = c.c_long
+    lib.dl4j_cache_trim.argtypes = [c.c_char_p, c.c_long]
     return lib
+
+
+def native_csv_parse(path, delimiter: str = ",", skip_header: bool = False,
+                     n_threads: int = 4):
+    """Parse a numeric CSV into a float32 [rows, cols] array using the
+    multi-threaded native parser; None if the native lib is unavailable or
+    the file can't be parsed (caller falls back to Python)."""
+    import numpy as np
+
+    lib = load_native_lib()
+    if lib is None:
+        return None
+    h = lib.dl4j_csv_parse(str(path).encode(), delimiter.encode(),
+                           int(skip_header), n_threads)
+    if not h:
+        return None
+    try:
+        rows, cols = lib.dl4j_csv_rows(h), lib.dl4j_csv_cols(h)
+        out = np.empty((rows, cols), np.float32)
+        lib.dl4j_csv_copy(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+    finally:
+        lib.dl4j_csv_free(h)
+
+
+def trim_compile_cache(cache_dir: Optional[str] = None,
+                       cap_bytes: int = 2 << 30) -> int:
+    """LRU-trim the persistent XLA compilation cache directory down to
+    cap_bytes (PJRT executable-cache management; libnd4j GraphHolder analog).
+    Returns bytes evicted (0 if under cap), -1 on error/no native lib."""
+    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                            str(_ROOT / ".jax_cache"))
+    lib = load_native_lib()
+    if lib is None or not os.path.isdir(cache_dir):
+        return -1
+    return int(lib.dl4j_cache_trim(str(cache_dir).encode(), int(cap_bytes)))
 
 
 def load_native_lib() -> Optional[ctypes.CDLL]:

@@ -8,15 +8,21 @@
 // exactly what XLA does not — host-side staging arenas and the producer
 // threads that keep the input pipeline ahead of the device step.
 
+#include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <dirent.h>
 #include <mutex>
 #include <new>
 #include <random>
+#include <string>
+#include <sys/stat.h>
 #include <thread>
 #include <vector>
 
@@ -256,6 +262,173 @@ void dl4j_pipe_destroy(void* handle) {
   auto* p = static_cast<Pipeline*>(handle);
   p->join_workers();
   delete p;
+}
+
+// ----------------------------------------------------------------- csv
+// Multi-threaded CSV -> float32 parser (DataVec CSVRecordReader's native
+// path; reference analog: datavec-api CSVRecordReader + the C++ ETL the
+// reference keeps in libnd4j for NDArray I/O). The file is split at line
+// boundaries into one chunk per thread; each thread parses its rows in
+// place. Only numeric CSVs (the RecordReader-to-DataSet path) are handled —
+// quoting/escaping is out of scope, like the reference's numeric fast path.
+struct CsvResult {
+  std::vector<float> data;
+  long rows = 0;
+  long cols = 0;
+};
+
+static long count_cols(const char* p, const char* end, char delim) {
+  while (p < end && (*p == '\n' || *p == '\r')) ++p;  // skip blank lines
+  long cols = 1;
+  for (; p < end && *p != '\n'; ++p)
+    if (*p == delim) ++cols;
+  return cols;
+}
+
+// Parse one field bounded to [q, field_end) — strtof would happily skip a
+// newline and read into the next row, so copy to a terminated buffer first.
+// Leading spaces/quotes are stripped (quoted numeric CSVs).
+static float parse_field(const char* q, const char* field_end) {
+  while (q < field_end && (*q == ' ' || *q == '\t' || *q == '"')) ++q;
+  char tmp[64];
+  size_t len = static_cast<size_t>(field_end - q);
+  if (len > 63) len = 63;
+  std::memcpy(tmp, q, len);
+  tmp[len] = '\0';
+  return std::strtof(tmp, nullptr);
+}
+
+void* dl4j_csv_parse(const char* path, char delim, int skip_header,
+                     int n_threads) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (std::fread(buf.data(), 1, static_cast<size_t>(size), f) !=
+      static_cast<size_t>(size)) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+
+  const char* begin = buf.data();
+  const char* end = begin + buf.size();
+  if (skip_header) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(begin, '\n', static_cast<size_t>(end - begin)));
+    begin = nl ? nl + 1 : end;
+  }
+  if (begin >= end) return nullptr;
+
+  long cols = count_cols(begin, end, delim);
+  if (n_threads <= 0) n_threads = 4;
+
+  // split at line boundaries
+  std::vector<const char*> starts{begin};
+  for (int t = 1; t < n_threads; ++t) {
+    const char* guess = begin + (end - begin) * t / n_threads;
+    const char* nl = static_cast<const char*>(
+        std::memchr(guess, '\n', static_cast<size_t>(end - guess)));
+    starts.push_back(nl ? nl + 1 : end);
+  }
+  starts.push_back(end);
+  std::sort(starts.begin(), starts.end());
+
+  std::vector<std::vector<float>> parts(static_cast<size_t>(n_threads));
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) {
+    threads.emplace_back([&, t] {
+      const char* p = starts[static_cast<size_t>(t)];
+      const char* stop = starts[static_cast<size_t>(t) + 1];
+      auto& out = parts[static_cast<size_t>(t)];
+      while (p < stop) {
+        const char* line_end = static_cast<const char*>(
+            std::memchr(p, '\n', static_cast<size_t>(stop - p)));
+        if (!line_end) line_end = stop;
+        const char* trimmed_end = line_end;
+        while (trimmed_end > p && trimmed_end[-1] == '\r') --trimmed_end;
+        if (trimmed_end > p) {  // skip empty lines
+          long c = 0;
+          const char* q = p;
+          while (c < cols) {
+            const char* fend = static_cast<const char*>(
+                std::memchr(q, delim, static_cast<size_t>(trimmed_end - q)));
+            if (!fend) fend = trimmed_end;
+            out.push_back(parse_field(q, fend));
+            ++c;
+            if (fend >= trimmed_end) break;
+            q = fend + 1;
+          }
+          for (; c < cols; ++c) out.push_back(0.0f);  // ragged row: zero-fill
+        }
+        p = line_end + 1;
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+
+  auto* res = new (std::nothrow) CsvResult();
+  if (!res) return nullptr;
+  size_t total = 0;
+  for (auto& part : parts) total += part.size();
+  res->data.reserve(total);
+  for (auto& part : parts)
+    res->data.insert(res->data.end(), part.begin(), part.end());
+  res->cols = cols;
+  res->rows = static_cast<long>(res->data.size()) / cols;
+  return res;
+}
+
+long dl4j_csv_rows(void* handle) { return static_cast<CsvResult*>(handle)->rows; }
+long dl4j_csv_cols(void* handle) { return static_cast<CsvResult*>(handle)->cols; }
+
+void dl4j_csv_copy(void* handle, float* out) {
+  auto* r = static_cast<CsvResult*>(handle);
+  std::memcpy(out, r->data.data(), r->data.size() * sizeof(float));
+}
+
+void dl4j_csv_free(void* handle) { delete static_cast<CsvResult*>(handle); }
+
+// ------------------------------------------------------------ compile cache
+// LRU size-cap manager for the persistent XLA compilation cache directory
+// (PJRT executable cache; reference analog: libnd4j's graph-instance cache
+// in include/graph/GraphHolder + the CUDA module cache). XLA writes one
+// file per compiled executable; this trims least-recently-used files until
+// the directory fits under cap_bytes. Returns bytes evicted, or -1.
+long dl4j_cache_trim(const char* dir, long cap_bytes) {
+  DIR* d = opendir(dir);
+  if (!d) return -1;
+  struct Entry {
+    std::string path;
+    long size;
+    long atime;
+  };
+  std::vector<Entry> entries;
+  long total = 0;
+  for (dirent* e; (e = readdir(d)) != nullptr;) {
+    if (e->d_name[0] == '.') continue;
+    std::string p = std::string(dir) + "/" + e->d_name;
+    struct stat st;
+    if (stat(p.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) continue;
+    // max(atime, mtime): relatime/noatime mounts leave atime stale, which
+    // would evict the hottest executables first
+    long recency = static_cast<long>(
+        st.st_atime > st.st_mtime ? st.st_atime : st.st_mtime);
+    entries.push_back({p, static_cast<long>(st.st_size), recency});
+    total += static_cast<long>(st.st_size);
+  }
+  closedir(d);
+  if (total <= cap_bytes) return 0;
+  std::sort(entries.begin(), entries.end(),
+            [](const Entry& a, const Entry& b) { return a.atime < b.atime; });
+  long evicted = 0;
+  for (const auto& ent : entries) {
+    if (total - evicted <= cap_bytes) break;
+    if (std::remove(ent.path.c_str()) == 0) evicted += ent.size;
+  }
+  return evicted;
 }
 
 }  // extern "C"

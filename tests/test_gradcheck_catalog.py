@@ -1,0 +1,288 @@
+"""Catalog-wide numeric gradient checks — one parameterized suite over every
+differentiable layer family.
+
+Reference analog: org.deeplearning4j.gradientcheck.* (GradientCheckTests,
+CNNGradientCheckTest, LSTMGradientCheckTests, GradientCheckTestsComputationGraph,
+YoloGradientCheckTests) — the reference runs a central numeric-vs-analytic
+checker over essentially the whole layer catalog in fp64; this file is that
+sweep. Shapes are tiny and checks sample few coordinates to keep runtime down
+(GradientCheckUtil samples the same way).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import grad_check, grad_check_model
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, AutoEncoderLayer, BidirectionalLayer, Convolution1DLayer,
+    Convolution3DLayer, ConvolutionLayer, Cropping2DLayer, Deconvolution2DLayer,
+    DenseLayer, DepthwiseConvolution2DLayer, ElementWiseMultiplicationLayer,
+    EmbeddingSequenceLayer, GlobalPoolingLayer, GravesBidirectionalLSTMLayer,
+    GRULayer, LastTimeStepLayer, LayerNormalizationLayer,
+    LearnedSelfAttentionLayer, LocalResponseNormalizationLayer, LSTMLayer,
+    OutputLayer, RMSNormLayer, RnnOutputLayer, SeparableConvolution2DLayer,
+    SimpleRnnLayer, SpaceToDepthLayer, Subsampling1DLayer, SubsamplingLayer,
+    TransformerEncoderLayer, Upsampling2DLayer, ZeroPadding2DLayer,
+)
+from deeplearning4j_tpu.optimize import Sgd
+
+
+def _check(conf_layers, itype, x, y, rtol=3e-2, checks=10):
+    b = NeuralNetConfiguration.builder().seed(7).updater(Sgd(lr=0.1)).list()
+    for l in conf_layers:
+        b = b.layer(l)
+    conf = b.set_input_type(itype).build()
+    model = MultiLayerNetwork(conf).init()
+    res = grad_check_model(model, x, y, rtol=rtol, max_checks_per_arg=checks)
+    assert res["ok"], (f"gradcheck failed: max_rel={res['max_rel_error']}, "
+                       f"first failures: {res['failures'][:3]}")
+
+
+def _ff_data(rng, n, fin, classes):
+    x = rng.normal(size=(n, fin)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def _seq_data(rng, n, t, fin, classes):
+    x = rng.normal(size=(n, t, fin)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, n * t)].reshape(n, t, classes)
+    return x, y
+
+
+def _img_data(rng, n, h, w, c, classes):
+    x = rng.normal(size=(n, h, w, c)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+OUT3 = OutputLayer(n_out=3, activation="softmax", loss="mcxent")
+ROUT2 = RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")
+
+CNN_CASES = {
+    "conv_dilated": [ConvolutionLayer(n_out=3, kernel=(3, 3), dilation=(2, 2),
+                                      activation="tanh")],
+    "separable_conv": [SeparableConvolution2DLayer(n_out=3, kernel=(3, 3),
+                                                   activation="tanh")],
+    "depthwise_conv": [DepthwiseConvolution2DLayer(kernel=(3, 3), depth_multiplier=2,
+                                                   activation="tanh")],
+    "deconv": [Deconvolution2DLayer(n_out=3, kernel=(2, 2), strides=(2, 2),
+                                    activation="tanh")],
+    "avgpool": [ConvolutionLayer(n_out=3, kernel=(3, 3), activation="tanh"),
+                SubsamplingLayer(kernel=(2, 2), pooling_type="avg")],
+    "pnormpool": [ConvolutionLayer(n_out=3, kernel=(3, 3), activation="tanh"),
+                  SubsamplingLayer(kernel=(2, 2), pooling_type="pnorm")],
+    "lrn": [ConvolutionLayer(n_out=4, kernel=(3, 3), activation="tanh"),
+            LocalResponseNormalizationLayer()],
+    "upsample_crop_pad": [ZeroPadding2DLayer(pad=((1, 1), (1, 1))),
+                          Upsampling2DLayer(size=(2, 2)),
+                          Cropping2DLayer(crop=((1, 1), (1, 1))),
+                          ConvolutionLayer(n_out=2, kernel=(3, 3), activation="tanh")],
+    "space_to_depth": [SpaceToDepthLayer(block=2)],
+    "global_pool_avg": [ConvolutionLayer(n_out=3, kernel=(3, 3), activation="tanh"),
+                        GlobalPoolingLayer(pooling_type="avg")],
+}
+
+
+@pytest.mark.parametrize("name", sorted(CNN_CASES))
+def test_cnn_family(rng, name):
+    x, y = _img_data(rng, 2, 8, 8, 2, 3)
+    _check(CNN_CASES[name] + [OUT3], InputType.convolutional(8, 8, 2), x, y)
+
+
+RNN_CASES = {
+    "gru": [GRULayer(n_out=5)],
+    "simple_rnn": [SimpleRnnLayer(n_out=5, activation="tanh")],
+    "bidirectional_lstm_concat": [BidirectionalLayer(fwd=LSTMLayer(n_out=4),
+                                                     mode="concat")],
+    "bidirectional_gru_add": [BidirectionalLayer(fwd=GRULayer(n_out=4), mode="add")],
+    "graves_bidirectional": [GravesBidirectionalLSTMLayer(n_out=4)],
+    "layer_norm_rnn": [SimpleRnnLayer(n_out=5, activation="tanh"),
+                       LayerNormalizationLayer()],
+    "rms_norm_rnn": [SimpleRnnLayer(n_out=5, activation="tanh"), RMSNormLayer()],
+    "learned_self_attention": [LearnedSelfAttentionLayer(n_out=6, n_heads=2,
+                                                         n_queries=3),
+                               SimpleRnnLayer(n_out=4, activation="tanh")],
+    "transformer_encoder": [TransformerEncoderLayer(d_model=6, n_heads=2)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(RNN_CASES))
+def test_rnn_family(rng, name):
+    fin = 6 if name in ("transformer_encoder",) else 4
+    x, y = _seq_data(rng, 2, 5, fin, 2)
+    itype = InputType.recurrent(fin, 5)
+    layers = RNN_CASES[name]
+    if name == "learned_self_attention":
+        # n_queries changes sequence length; use plain rnn output after
+        y = np.eye(2, dtype=np.float32)[
+            np.random.default_rng(0).integers(0, 2, 2 * 3)].reshape(2, 3, 2)
+    _check(layers + [ROUT2], itype, x, y)
+
+
+def test_rnn_masked_gradients(rng):
+    """Masked timesteps must contribute zero gradient (reference: masking
+    variants in LSTMGradientCheckTests)."""
+    x, y = _seq_data(rng, 2, 5, 4, 2)
+    mask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+    b = NeuralNetConfiguration.builder().seed(7).updater(Sgd(lr=0.1)).list()
+    for l in [LSTMLayer(n_out=4), ROUT2]:
+        b = b.layer(l)
+    model = MultiLayerNetwork(b.set_input_type(InputType.recurrent(4, 5)).build()).init()
+    res = grad_check_model(model, x, y, mask=mask, rtol=3e-2, max_checks_per_arg=10)
+    assert res["ok"], res["failures"][:3]
+
+
+FF_CASES = {
+    "elementwise_mult": [DenseLayer(n_out=5, activation="tanh"),
+                         ElementWiseMultiplicationLayer()],
+    "autoencoder": [AutoEncoderLayer(n_out=4, activation="tanh")],
+    "parametric_activation": [DenseLayer(n_out=5, activation="identity"),
+                              ActivationLayer(activation="leakyrelu:0.3")],
+}
+
+
+@pytest.mark.parametrize("name", sorted(FF_CASES))
+def test_ff_family(rng, name):
+    x, y = _ff_data(rng, 6, 5, 3)
+    _check(FF_CASES[name] + [OUT3], InputType.feed_forward(5), x, y)
+
+
+def test_conv1d_chain(rng):
+    x = rng.normal(size=(2, 8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 2)]
+    _check([Convolution1DLayer(n_out=4, kernel=3, activation="tanh"),
+            Subsampling1DLayer(kernel=2, pooling_type="max"),
+            GlobalPoolingLayer(pooling_type="max"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+           InputType.recurrent(3, 8), x, y)
+
+
+def test_conv3d_chain(rng):
+    x = rng.normal(size=(2, 4, 4, 4, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 2)]
+    _check([Convolution3DLayer(n_out=3, kernel=(2, 2, 2), activation="tanh"),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+           InputType.convolutional3d(4, 4, 4, 2), x, y)
+
+
+def test_embedding_sequence(rng):
+    ids = rng.integers(0, 9, size=(3, 5)).astype(np.int32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3 * 5)].reshape(3, 5, 2)
+    b = NeuralNetConfiguration.builder().seed(7).updater(Sgd(lr=0.1)).list()
+    for l in [EmbeddingSequenceLayer(n_in=9, n_out=4),
+              SimpleRnnLayer(n_out=4, activation="tanh"), ROUT2]:
+        b = b.layer(l)
+    conf = b.set_input_type(InputType.recurrent(1, 5)).build()
+    model = MultiLayerNetwork(conf).init()
+    # integer inputs aren't differentiable; check params only (default)
+    res = grad_check_model(model, ids, y, rtol=3e-2, max_checks_per_arg=10)
+    assert res["ok"], res["failures"][:3]
+
+
+def test_last_timestep_wrapper(rng):
+    x = rng.normal(size=(3, 5, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+    _check([LastTimeStepLayer(underlying=LSTMLayer(n_out=4)),
+            OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+           InputType.recurrent(4, 5), x, y)
+
+
+@pytest.mark.parametrize("loss", ["hinge", "squaredhinge", "poisson",
+                                  "kld", "msle", "mape", "cosineproximity"])
+def test_loss_catalog_gradients(rng, loss):
+    """OpValidation analog for the remaining loss ops."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.losses import get_loss
+
+    fn = get_loss(loss)
+    if loss in ("hinge", "squaredhinge"):
+        y = np.where(rng.random((4, 3)) > 0.5, 1.0, -1.0).astype(np.float32)
+        p = rng.normal(size=(4, 3)).astype(np.float32)
+    elif loss in ("poisson", "kld", "msle", "mape"):
+        y = (np.abs(rng.normal(size=(4, 3))) + 0.2).astype(np.float32)
+        p = (np.abs(rng.normal(size=(4, 3))) + 0.2).astype(np.float32)
+    else:
+        y = rng.normal(size=(4, 3)).astype(np.float32)
+        p = rng.normal(size=(4, 3)).astype(np.float32)
+    res = grad_check(lambda a: get_loss(loss)(jnp.asarray(y), a).sum(),
+                     jnp.asarray(p), rtol=3e-2)
+    assert res["ok"], f"{loss}: {res['failures'][:2]}"
+
+
+class TestGraphGradients:
+    """GradientCheckTestsComputationGraph analog: DAG topologies."""
+
+    def _residual(self):
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+
+        return (NeuralNetConfiguration.builder().seed(5).updater(Sgd(lr=0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.feed_forward(6)})
+                .add_layer("fc1", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("fc2", DenseLayer(n_out=6, activation="identity"), "fc1")
+                .add_vertex("res", ElementWiseVertex(op="add"), "fc2", "fc1")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "res")
+                .set_outputs("out").build())
+
+    def test_residual_gradients(self, rng):
+        from deeplearning4j_tpu.autodiff import grad_check_graph
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        model = ComputationGraph(self._residual()).init()
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        res = grad_check_graph(model, {"in": x}, {"out": y}, rtol=3e-2,
+                               max_checks_per_arg=10)
+        assert res["ok"], res["failures"][:3]
+
+    def test_multi_input_merge_gradients(self, rng):
+        from deeplearning4j_tpu.autodiff import grad_check_graph
+        from deeplearning4j_tpu.nn import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(lr=0.1))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(a=InputType.feed_forward(4),
+                                 b=InputType.feed_forward(3))
+                .add_layer("fa", DenseLayer(n_out=5, activation="tanh"), "a")
+                .add_layer("fb", DenseLayer(n_out=4, activation="tanh"), "b")
+                .add_vertex("m", MergeVertex(), "fa", "fb")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out").build())
+        model = ComputationGraph(conf).init()
+        xa = rng.normal(size=(4, 4)).astype(np.float32)
+        xb = rng.normal(size=(4, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        res = grad_check_graph(model, {"a": xa, "b": xb}, {"out": y}, rtol=3e-2,
+                               max_checks_per_arg=10)
+        assert res["ok"], res["failures"][:3]
+
+    def test_multi_output_gradients(self, rng):
+        from deeplearning4j_tpu.autodiff import grad_check_graph
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(lr=0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.feed_forward(5)})
+                .add_layer("trunk", DenseLayer(n_out=6, activation="tanh"), "in")
+                .add_layer("out1", OutputLayer(n_out=2, activation="softmax",
+                                               loss="mcxent"), "trunk")
+                .add_layer("out2", OutputLayer(n_out=3, activation="identity",
+                                               loss="mse"), "trunk")
+                .set_outputs("out1", "out2").build())
+        model = ComputationGraph(conf).init()
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        y1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        y2 = rng.normal(size=(4, 3)).astype(np.float32)
+        res = grad_check_graph(model, {"in": x}, {"out1": y1, "out2": y2},
+                               rtol=3e-2, max_checks_per_arg=10)
+        assert res["ok"], res["failures"][:3]

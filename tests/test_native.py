@@ -123,3 +123,118 @@ class TestNativePipeline:
         assert not it_p.native
         py_rows = np.concatenate([ds.features for ds in it_p])
         np.testing.assert_array_equal(native_rows, py_rows)
+
+
+class TestNativeCsv:
+    def test_csv_matches_python(self, tmp_path, rng):
+        import numpy as np
+
+        from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        data = rng.normal(size=(1000, 7)).astype(np.float32)
+        path = tmp_path / "data.csv"
+        np.savetxt(path, data, delimiter=",", fmt="%.6f")
+        arr = native_csv_parse(path, n_threads=4)
+        assert arr is not None and arr.shape == (1000, 7)
+        np.testing.assert_allclose(arr, data, rtol=0, atol=1e-5)
+
+    def test_csv_header_and_reader_fastpath(self, tmp_path, rng):
+        import numpy as np
+
+        from deeplearning4j_tpu.datavec.records import CSVRecordReader
+        from deeplearning4j_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        data = rng.normal(size=(50, 3)).astype(np.float32)
+        path = tmp_path / "d.csv"
+        with open(path, "w") as f:
+            f.write("a,b,c\n")
+            for row in data:
+                f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+        arr = CSVRecordReader(path, skip_lines=1).numeric_array()
+        assert arr.shape == (50, 3)
+        np.testing.assert_allclose(arr, data, rtol=0, atol=1e-5)
+
+    def test_csv_parse_thread_split_consistency(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        # rows whose values encode their index — catches any line-boundary
+        # mis-splitting across threads
+        n = 10007  # prime, odd split points
+        path = tmp_path / "idx.csv"
+        with open(path, "w") as f:
+            for i in range(n):
+                f.write(f"{i},{i*2},{i*3}\n")
+        for t in (1, 3, 8):
+            arr = native_csv_parse(path, n_threads=t)
+            assert arr.shape == (n, 3), (t, arr.shape)
+            np.testing.assert_array_equal(arr[:, 0], np.arange(n, dtype=np.float32))
+            np.testing.assert_array_equal(arr[:, 1], 2 * np.arange(n, dtype=np.float32))
+
+
+class TestCacheTrim:
+    def test_lru_trim(self, tmp_path):
+        import os
+        import time
+
+        from deeplearning4j_tpu.native import native_available, trim_compile_cache
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        d = tmp_path / "cache"
+        d.mkdir()
+        for i in range(5):
+            (d / f"exec_{i}.bin").write_bytes(b"x" * 1000)
+            os.utime(d / f"exec_{i}.bin", (time.time() - 1000 + i, time.time() - 1000 + i))
+        # cap at 2500 bytes -> the 3 oldest files must go
+        evicted = trim_compile_cache(str(d), 2500)
+        assert evicted == 3000
+        left = sorted(p.name for p in d.iterdir())
+        assert left == ["exec_3.bin", "exec_4.bin"]
+        # under cap: no-op
+        assert trim_compile_cache(str(d), 1 << 20) == 0
+
+
+class TestNativeCsvEdgeCases:
+    def test_trailing_delimiter_rows(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        path = tmp_path / "t.csv"
+        path.write_text("1,2,\n4,5,\n")
+        arr = native_csv_parse(path)
+        np.testing.assert_array_equal(arr, [[1, 2, 0], [4, 5, 0]])
+
+    def test_quoted_numeric_fields(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        path = tmp_path / "q.csv"
+        path.write_text('"1","2"\n"3","4"\n')
+        arr = native_csv_parse(path)
+        np.testing.assert_array_equal(arr, [[1, 2], [3, 4]])
+
+    def test_leading_blank_line_and_crlf(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        path = tmp_path / "b.csv"
+        path.write_bytes(b"\n1,2,3\r\n4,5,6\r\n")
+        arr = native_csv_parse(path)
+        np.testing.assert_array_equal(arr, [[1, 2, 3], [4, 5, 6]])
