@@ -59,6 +59,27 @@ def _measure(step_fn, args, loss_index, warmup=2, iters=50):
     return (time.perf_counter() - t0) / iters
 
 
+def _measurer(model, batch, make_one):
+    """Shared measurement scaffolding: wraps a model's jitted train step into
+    measure() -> samples/sec. Fresh state copies each round (the step donates
+    its buffers); completion forced by _measure's host-fetch barrier."""
+    import jax
+    import jax.numpy as jnp
+
+    step = model._jit_cache.get("train") or model._make_train_step()
+    one = make_one(step)
+    state0 = (model.params, model.state, model.opt_state)
+
+    def measure():
+        args = tuple(jax.tree_util.tree_map(lambda a: a + 0, t) for t in state0) + (
+            jnp.asarray(0, jnp.int32), jnp.asarray(0.0))
+        return batch / _measure(one, args, loss_index=4)
+
+    measure.step = step
+    measure.state0 = state0
+    return measure
+
+
 def make_ours(batch):
     """Build once; returns measure() -> samples/sec using fresh state."""
     import jax
@@ -71,22 +92,17 @@ def make_ours(batch):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
-
-    step = model._jit_cache.get("train") or model._make_train_step()
     key = jax.random.key(0)
 
-    def one(params, state, opt_state, i, _prev_loss):
-        p, s, o, loss = step(params, state, opt_state, i, {"input": x},
-                             {"output": y}, key, None)
-        return p, s, o, i + 1, loss
+    def make_one(step):
+        def one(params, state, opt_state, i, _prev_loss):
+            p, s, o, loss = step(params, state, opt_state, i, {"input": x},
+                                 {"output": y}, key, None)
+            return p, s, o, i + 1, loss
+        return one
 
-    state0 = (model.params, model.state, model.opt_state)
-
-    def measure():
-        # fresh copies each round: the step donates its buffers
-        args = tuple(jax.tree_util.tree_map(lambda a: a + 0, t) for t in state0) + (
-            jnp.asarray(0, jnp.int32), jnp.asarray(0.0))
-        return batch / _measure(one, args, loss_index=4)
+    measure = _measurer(model, batch, make_one)
+    step, state0 = measure.step, measure.state0
 
     flops_cache = []
 
@@ -190,10 +206,89 @@ def bench_flax_reference(batch):
     return make_flax_reference(batch)()
 
 
+def make_mln(model, x, y):
+    """Generic measurer over a MultiLayerNetwork zoo model's jitted train step
+    (the other BASELINE configs: LeNet-MNIST, char-RNN LSTM, BERT fine-tune).
+    Same scaffolding as make_ours; only x/y passing differs (bare arrays vs
+    the ComputationGraph's input/label dicts)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    key = jax.random.key(0)
+
+    def make_one(step):
+        def one(params, state, opt_state, i, _prev_loss):
+            p, s, o, loss = step(params, state, opt_state, i, x, y, key, None)
+            return p, s, o, i + 1, loss
+        return one
+
+    return _measurer(model, x.shape[0], make_one)
+
+
+def make_mode(mode, batch):
+    """BASELINE configs 1/3/4 (ResNet-50 is the separate A/B path)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    if mode == "lenet":
+        from deeplearning4j_tpu.zoo import LeNet
+
+        model = LeNet().init()
+        x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+        label = "LeNet-MNIST train throughput"
+    elif mode == "lstm":
+        from deeplearning4j_tpu.zoo import BidirectionalGravesLSTMCharRnn
+
+        model = BidirectionalGravesLSTMCharRnn().init()
+        T, V = 64, 77
+        ids = rng.integers(0, V, (batch, T))
+        x = np.eye(V, dtype=np.float32)[ids]
+        y = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+        label = "Bidirectional GravesLSTM char-RNN train throughput"
+    elif mode == "bert":
+        from deeplearning4j_tpu.zoo import BertBase
+
+        model = BertBase().init()
+        x = rng.integers(0, 30522, (batch, 128)).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch)]
+        label = "BERT-base fine-tune train throughput (seq 128)"
+    else:
+        raise SystemExit(f"unknown bench mode '{mode}' "
+                         f"(expected resnet50|lenet|lstm|bert)")
+    return make_mln(model, x, y), label
+
+
 def main():
     _enable_compile_cache()
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    # argv: [mode] [batch] — a bare number is a resnet50 batch (back-compat)
+    mode, batch = "resnet50", None
+    for a in sys.argv[1:3]:
+        if a.isdigit():
+            batch = int(a)
+        else:
+            mode = a
     rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+
+    if mode != "resnet50":
+        defaults = {"lenet": 512, "lstm": 64, "bert": 32}
+        if mode not in defaults:
+            raise SystemExit(f"unknown bench mode '{mode}' "
+                             f"(expected resnet50|lenet|lstm|bert)")
+        batch = batch or defaults[mode]
+        fn, label = make_mode(mode, batch)
+        runs = sorted(fn() for _ in range(rounds))
+        print(json.dumps({
+            "metric": "%s (zoo entrypoint, batch %d, median of %d rounds)"
+                      % (label, batch, rounds),
+            "value": round(runs[len(runs) // 2], 2),
+            "unit": "samples/sec/chip",
+            "vs_baseline": None,
+        }))
+        return
+    batch = batch or 64
 
     def run_rounds(b):
         # Shared tunneled backends drift +/-30% over minutes; interleave A/B

@@ -65,6 +65,8 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_csv_parse.argtypes = [c.c_char_p, c.c_char, c.c_int, c.c_int]
     lib.dl4j_csv_rows.restype = c.c_long
     lib.dl4j_csv_rows.argtypes = [c.c_void_p]
+    lib.dl4j_csv_bad_fields.restype = c.c_long
+    lib.dl4j_csv_bad_fields.argtypes = [c.c_void_p]
     lib.dl4j_csv_cols.restype = c.c_long
     lib.dl4j_csv_cols.argtypes = [c.c_void_p]
     lib.dl4j_csv_copy.argtypes = [c.c_void_p, c.POINTER(c.c_float)]
@@ -90,6 +92,10 @@ def native_csv_parse(path, delimiter: str = ",", skip_header: bool = False,
     if not h:
         return None
     try:
+        if lib.dl4j_csv_bad_fields(h):
+            # non-numeric content: refuse rather than return silent zeros —
+            # the Python fallback will raise (or parse strings) consistently
+            return None
         rows, cols = lib.dl4j_csv_rows(h), lib.dl4j_csv_cols(h)
         out = np.empty((rows, cols), np.float32)
         lib.dl4j_csv_copy(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
@@ -128,8 +134,20 @@ def load_native_lib() -> Optional[ctypes.CDLL]:
             return None
         try:
             _lib = _declare(ctypes.CDLL(str(_SO)))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: stale .so missing newer symbols. Rebuild, then
+            # load under a unique path — dlopen caches by pathname, so
+            # reopening _SO would hand back the stale library.
             _lib = None
+            if _build():
+                import shutil
+
+                alt = _SO.with_name(f"libdl4jtpu.{os.getpid()}.so")
+                try:
+                    shutil.copy2(_SO, alt)
+                    _lib = _declare(ctypes.CDLL(str(alt)))
+                except (OSError, AttributeError):
+                    _lib = None
         return _lib
 
 

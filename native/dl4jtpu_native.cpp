@@ -275,6 +275,7 @@ struct CsvResult {
   std::vector<float> data;
   long rows = 0;
   long cols = 0;
+  long bad_fields = 0;  // non-empty fields that failed numeric parse
 };
 
 static long count_cols(const char* p, const char* end, char delim) {
@@ -287,15 +288,20 @@ static long count_cols(const char* p, const char* end, char delim) {
 
 // Parse one field bounded to [q, field_end) — strtof would happily skip a
 // newline and read into the next row, so copy to a terminated buffer first.
-// Leading spaces/quotes are stripped (quoted numeric CSVs).
-static float parse_field(const char* q, const char* field_end) {
+// Leading spaces/quotes are stripped (quoted numeric CSVs). Non-numeric,
+// non-empty fields increment *bad so the caller can reject the parse
+// instead of silently training on zeros.
+static float parse_field(const char* q, const char* field_end, long* bad) {
   while (q < field_end && (*q == ' ' || *q == '\t' || *q == '"')) ++q;
   char tmp[64];
   size_t len = static_cast<size_t>(field_end - q);
   if (len > 63) len = 63;
   std::memcpy(tmp, q, len);
   tmp[len] = '\0';
-  return std::strtof(tmp, nullptr);
+  char* endp = nullptr;
+  float v = std::strtof(tmp, &endp);
+  if (endp == tmp && len > 0) ++*bad;
+  return v;
 }
 
 void* dl4j_csv_parse(const char* path, char delim, int skip_header,
@@ -337,12 +343,14 @@ void* dl4j_csv_parse(const char* path, char delim, int skip_header,
   std::sort(starts.begin(), starts.end());
 
   std::vector<std::vector<float>> parts(static_cast<size_t>(n_threads));
+  std::vector<long> bads(static_cast<size_t>(n_threads), 0);
   std::vector<std::thread> threads;
   for (int t = 0; t < n_threads; ++t) {
     threads.emplace_back([&, t] {
       const char* p = starts[static_cast<size_t>(t)];
       const char* stop = starts[static_cast<size_t>(t) + 1];
       auto& out = parts[static_cast<size_t>(t)];
+      long* bad = &bads[static_cast<size_t>(t)];
       while (p < stop) {
         const char* line_end = static_cast<const char*>(
             std::memchr(p, '\n', static_cast<size_t>(stop - p)));
@@ -356,7 +364,7 @@ void* dl4j_csv_parse(const char* path, char delim, int skip_header,
             const char* fend = static_cast<const char*>(
                 std::memchr(q, delim, static_cast<size_t>(trimmed_end - q)));
             if (!fend) fend = trimmed_end;
-            out.push_back(parse_field(q, fend));
+            out.push_back(parse_field(q, fend, bad));
             ++c;
             if (fend >= trimmed_end) break;
             q = fend + 1;
@@ -378,10 +386,15 @@ void* dl4j_csv_parse(const char* path, char delim, int skip_header,
     res->data.insert(res->data.end(), part.begin(), part.end());
   res->cols = cols;
   res->rows = static_cast<long>(res->data.size()) / cols;
+  for (long b : bads) res->bad_fields += b;
   return res;
 }
 
 long dl4j_csv_rows(void* handle) { return static_cast<CsvResult*>(handle)->rows; }
+
+long dl4j_csv_bad_fields(void* handle) {
+  return static_cast<CsvResult*>(handle)->bad_fields;
+}
 long dl4j_csv_cols(void* handle) { return static_cast<CsvResult*>(handle)->cols; }
 
 void dl4j_csv_copy(void* handle, float* out) {

@@ -238,3 +238,15 @@ class TestNativeCsvEdgeCases:
         path.write_bytes(b"\n1,2,3\r\n4,5,6\r\n")
         arr = native_csv_parse(path)
         np.testing.assert_array_equal(arr, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_non_numeric_csv_rejected(tmp_path):
+    """Native fast path must refuse files with non-numeric fields rather than
+    silently zero-filling them (falls back to the Python parser)."""
+    from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    path = tmp_path / "labeled.csv"
+    path.write_text("1.0,2.0,setosa\n3.0,4.0,virginica\n")
+    assert native_csv_parse(path) is None

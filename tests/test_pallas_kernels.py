@@ -115,3 +115,40 @@ class TestFusedLSTM:
         R2 = jnp.zeros((100, 400))
         assert op.select(x, jnp.zeros((8, 100)), jnp.zeros((8, 100)),
                          jnp.zeros((16, 400)), R2, jnp.zeros(400)).platform == "xla"
+
+
+class TestPallasLRN:
+    def test_matches_xla_lowering(self, rng):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.convolution import lrn as xla_lrn
+        from deeplearning4j_tpu.ops.pallas import pallas_lrn
+
+        x = jnp.asarray(rng.normal(size=(2, 8, 8, 64)).astype(np.float32))
+        got = np.asarray(pallas_lrn(x))
+        want = np.asarray(xla_lrn(x))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    def test_gradient_matches(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.convolution import lrn as xla_lrn
+        from deeplearning4j_tpu.ops.pallas import pallas_lrn
+
+        x = jnp.asarray(rng.normal(size=(1, 4, 4, 64)).astype(np.float32))
+        g1 = jax.grad(lambda a: (pallas_lrn(a) ** 2).sum())(x)
+        g2 = jax.grad(lambda a: (xla_lrn(a) ** 2).sum())(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=2e-4, atol=2e-6)
+
+    def test_registry_selection(self, rng):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        big = jnp.zeros((4, 32, 32, 64), jnp.float32)   # 4096 pixels
+        small = jnp.zeros((1, 4, 4, 8), jnp.float32)
+        op = get_op("lrn")
+        assert op.select(big).platform == "pallas"
+        assert op.select(small).platform != "pallas"
