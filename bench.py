@@ -256,8 +256,7 @@ def make_mode(mode, batch):
         y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch)]
         label = "BERT-base fine-tune train throughput (seq 128)"
     else:
-        raise SystemExit(f"unknown bench mode '{mode}' "
-                         f"(expected resnet50|lenet|lstm|bert)")
+        raise ValueError(f"make_mode: unknown mode {mode!r}")
     return make_mln(model, x, y), label
 
 

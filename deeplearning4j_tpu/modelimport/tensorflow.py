@@ -348,6 +348,182 @@ def _pad_op(node, xs):
     return jnp.pad(xs[0], [(int(a), int(b)) for a, b in pads])
 
 
+@tf_op("GatherV2", "Gather")
+def _gather(node, xs):
+    bd = node.attr("batch_dims")
+    if bd and bd.i:
+        raise NotImplementedError("GatherV2 batch_dims > 0 is not supported")
+    axis = int(np.asarray(xs[2]).ravel()[0]) if len(xs) > 2 else 0
+    return jnp.take(xs[0], jnp.asarray(xs[1]).astype(jnp.int32), axis=axis)
+
+
+@tf_op("BatchMatMul", "BatchMatMulV2")
+def _batch_matmul(node, xs):
+    a, b = xs
+    adj_x, adj_y = node.attr("adj_x"), node.attr("adj_y")
+    if adj_x and adj_x.b:
+        a = jnp.swapaxes(a, -1, -2)
+    if adj_y and adj_y.b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@tf_op("Transpose")
+def _transpose(node, xs):
+    perm = [int(p) for p in np.asarray(xs[1]).ravel()]
+    return jnp.transpose(xs[0], perm)
+
+
+@tf_op("Erf")
+def _erf(node, xs):
+    return jax.scipy.special.erf(xs[0])
+
+
+@tf_op("Pow")
+def _pow(node, xs):
+    return jnp.power(xs[0], xs[1])
+
+
+@tf_op("Rsqrt")
+def _rsqrt(node, xs):
+    return 1.0 / jnp.sqrt(xs[0])
+
+
+@tf_op("Sqrt")
+def _sqrt(node, xs):
+    return jnp.sqrt(xs[0])
+
+
+@tf_op("Square")
+def _square(node, xs):
+    return jnp.square(xs[0])
+
+
+@tf_op("SquaredDifference")
+def _sqdiff(node, xs):
+    d = xs[0] - xs[1]
+    return d * d
+
+
+@tf_op("Neg")
+def _neg(node, xs):
+    return -xs[0]
+
+
+@tf_op("Exp")
+def _exp(node, xs):
+    return jnp.exp(xs[0])
+
+
+@tf_op("Log")
+def _log(node, xs):
+    return jnp.log(xs[0])
+
+
+@tf_op("Abs")
+def _abs(node, xs):
+    return jnp.abs(xs[0])
+
+
+@tf_op("Maximum")
+def _maximum(node, xs):
+    return jnp.maximum(xs[0], xs[1])
+
+
+@tf_op("Minimum")
+def _minimum(node, xs):
+    return jnp.minimum(xs[0], xs[1])
+
+
+@tf_op("AddN")
+def _add_n(node, xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@tf_op("LeakyRelu")
+def _leaky_relu(node, xs):
+    a = node.attr("alpha")
+    return jax.nn.leaky_relu(xs[0], a.f if a and a.f is not None else 0.2)
+
+
+@tf_op("Softplus")
+def _softplus(node, xs):
+    return jax.nn.softplus(xs[0])
+
+
+_TF_CAST_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 9: jnp.int64,
+                   10: jnp.bool_, 14: jnp.bfloat16}
+
+
+@tf_op("Cast")
+def _cast(node, xs):
+    dst = node.attr("DstT")
+    return xs[0].astype(_TF_CAST_DTYPES.get(dst.type if dst else 1, jnp.float32))
+
+
+@tf_op("OneHot")
+def _one_hot(node, xs):
+    ax = node.attr("axis")
+    if ax and ax.i is not None and ax.i not in (-1,):
+        raise NotImplementedError("OneHot axis != -1 is not supported")
+    depth = int(np.asarray(xs[1]).ravel()[0])
+    on = np.asarray(xs[2]).ravel()[0] if len(xs) > 2 else 1.0
+    off = np.asarray(xs[3]).ravel()[0] if len(xs) > 3 else 0.0
+    oh = jax.nn.one_hot(jnp.asarray(xs[0]).astype(jnp.int32), depth)
+    return oh * (on - off) + off
+
+
+@tf_op("Sum")
+def _sum(node, xs):
+    axes = tuple(int(a) for a in np.asarray(xs[1]).ravel())
+    keep = node.attr("keep_dims")
+    return jnp.sum(xs[0], axis=axes, keepdims=bool(keep.b) if keep else False)
+
+
+@tf_op("Slice")
+def _slice_op(node, xs):
+    begin = [int(b) for b in np.asarray(xs[1]).ravel()]
+    size = [int(s) for s in np.asarray(xs[2]).ravel()]
+    size = [x - b if s == -1 else s
+            for b, s, x in zip(begin, size, xs[0].shape)]
+    return jax.lax.dynamic_slice(xs[0], begin, size)
+
+
+@tf_op("StridedSlice")
+def _strided_slice_op(node, xs):
+    # begin/end/shrink-axis masks supported; ellipsis/new-axis raise rather
+    # than silently mis-slicing (the importer's fail-loud convention)
+    for unsupported in ("ellipsis_mask", "new_axis_mask"):
+        a = node.attr(unsupported)
+        if a and a.i:
+            raise NotImplementedError(f"StridedSlice {unsupported} is not supported")
+    begin = [int(b) for b in np.asarray(xs[1]).ravel()]
+    end = [int(e) for e in np.asarray(xs[2]).ravel()]
+    strides = [int(s) for s in np.asarray(xs[3]).ravel()]
+    bm = node.attr("begin_mask")
+    em = node.attr("end_mask")
+    sm = node.attr("shrink_axis_mask")
+    bm = bm.i if bm and bm.i else 0
+    em = em.i if em and em.i else 0
+    sm = sm.i if sm and sm.i else 0
+    sl = []
+    for i, (b, e, s) in enumerate(zip(begin, end, strides)):
+        if sm & (1 << i):
+            sl.append(b)  # integer index performs the shrink
+        else:
+            sl.append(slice(None if bm & (1 << i) else b,
+                            None if em & (1 << i) else e, s))
+    return xs[0][tuple(sl)]
+
+
+@tf_op("Tile")
+def _tile(node, xs):
+    return jnp.tile(xs[0], [int(r) for r in np.asarray(xs[1]).ravel()])
+
+
 @tf_op("FusedBatchNorm", "FusedBatchNormV3")
 def _fused_bn(node, xs):
     x, scale, offset, mean, var = xs[:5]

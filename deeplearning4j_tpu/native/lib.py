@@ -141,13 +141,24 @@ def load_native_lib() -> Optional[ctypes.CDLL]:
             _lib = None
             if _build():
                 import shutil
+                import tempfile
 
-                alt = _SO.with_name(f"libdl4jtpu.{os.getpid()}.so")
+                alt = None
                 try:
+                    with tempfile.NamedTemporaryFile(suffix=".so",
+                                                     delete=False) as f:
+                        alt = f.name
                     shutil.copy2(_SO, alt)
-                    _lib = _declare(ctypes.CDLL(str(alt)))
+                    _lib = _declare(ctypes.CDLL(alt))
                 except (OSError, AttributeError):
                     _lib = None
+                finally:
+                    # the dlopen mapping survives the unlink on Linux
+                    if alt is not None:
+                        try:
+                            os.unlink(alt)
+                        except OSError:
+                            pass
         return _lib
 
 

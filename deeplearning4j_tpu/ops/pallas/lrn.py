@@ -36,9 +36,14 @@ def _lrn_forward(x, *, depth, alpha, beta, k, block_rows, interpret):
     xf = x.reshape(-1, C)
     R = xf.shape[0]
     br = min(block_rows, R)
+    # the XLA lowering's window spans offsets [-half, depth-1-half] (exactly
+    # `depth` channels — asymmetric when depth is even). Output channel j of
+    # sq @ band sums input channels i with band[i, j] = 1, so the condition
+    # is on i - j.
     half = depth // 2
     idx = jnp.arange(C)
-    band = (jnp.abs(idx[:, None] - idx[None, :]) <= half).astype(jnp.float32)
+    off = idx[:, None] - idx[None, :]
+    band = ((off >= -half) & (off <= depth - 1 - half)).astype(jnp.float32)
     out = pl.pallas_call(
         functools.partial(_lrn_kernel, alpha=alpha, beta=beta, k=k),
         out_shape=jax.ShapeDtypeStruct(xf.shape, x.dtype),

@@ -27,6 +27,9 @@ from deeplearning4j_tpu.parallel.distributed import (
 from deeplearning4j_tpu.parallel.sequence import (
     ring_attention, sequence_parallel_encoder, ulysses_attention,
 )
+from deeplearning4j_tpu.parallel.compression import (
+    EncodedGradientTrainer, message_density, threshold_encode,
+)
 
 __all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference", "TensorParallel",
            "GPipe", "pipeline_train_step", "stack_stage_params",
@@ -34,4 +37,5 @@ __all__ = ["DeviceMesh", "ParallelWrapper", "ParallelInference", "TensorParallel
            "switch_moe", "FaultTolerantTrainer", "initialize_distributed",
            "SparkDl4jMultiLayer", "SparkComputationGraph",
            "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
-           "ring_attention", "ulysses_attention", "sequence_parallel_encoder"]
+           "ring_attention", "ulysses_attention", "sequence_parallel_encoder",
+           "EncodedGradientTrainer", "threshold_encode", "message_density"]

@@ -1,0 +1,172 @@
+"""Threshold-encoded gradient sharing — the EncodedGradientsAccumulator
+analog, for bandwidth-constrained meshes.
+
+Reference analog (SURVEY.md §2.4): org.deeplearning4j.optimize.solvers.
+accumulation.EncodedGradientsAccumulator + ThresholdAlgorithm — Strom-style
+encoding where each update message carries only the entries whose magnitude
+clears a threshold, quantized to ±threshold, with the remainder accumulated
+locally (error feedback) for later rounds; an adaptive algorithm tunes the
+threshold toward a target message density.
+
+TPU-native redesign: on an ICI mesh plain psum wins (no encoding needed —
+ParallelWrapper's path). This module is the DCN/multi-slice experiment the
+survey calls for: the SAME semantics expressed as one SPMD step under
+shard_map — per-device grads on the local batch shard, error-feedback
+residual carried in the training state, ternary ±thr quantization, one
+all-reduce of the (highly compressible) encoded tensor, and a density-driven
+threshold adaptation. No host threads, no IndexedTail queues — the entire
+accumulator collapses into pure carried state.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel._compat import shard_map
+
+
+def threshold_encode(g, thr):
+    """Ternary Strom encoding of one tensor: entries |g| >= thr become
+    ±thr, the rest 0. Returns (encoded, residual) — residual = g - encoded
+    is the error feedback the reference accumulates for later rounds."""
+    q = jnp.where(g >= thr, thr, jnp.where(g <= -thr, -thr, 0.0))
+    return q, g - q
+
+
+def message_density(encoded, thr):
+    """Fraction of nonzero entries in an encoded tensor (the quantity the
+    reference's ThresholdAlgorithm steers)."""
+    total = sum(leaf.size for leaf in jax.tree_util.tree_leaves(encoded))
+    nz = sum(jnp.sum(jnp.abs(leaf) > 0.5 * thr)
+             for leaf in jax.tree_util.tree_leaves(encoded))
+    return nz / total
+
+
+class EncodedGradientTrainer:
+    """Data-parallel trainer whose update exchange is threshold-encoded.
+
+    loss_fn(params, x, y) -> scalar loss on the LOCAL batch shard.
+    Matches the reference's semantics: each worker computes its LOCAL
+    lr-scaled update, encodes it (entries |u| >= thr quantized to ±thr, the
+    remainder kept as local error-feedback residual — what the reference's
+    EncodedGradientsAccumulator stores between rounds), and every worker
+    applies the SUM of all workers' decoded messages (the reference applies
+    each peer's decoded update as it arrives). The step carries
+    {params, residual, thr} inside one jitted shard_map over ``axis``:
+
+        u_local  = lr * grad(loss_fn)(params, x_shard, y_shard) + residual
+        q, resid = threshold_encode(u_local, thr)
+        params  <- params - psum(q)          # the ONLY cross-device traffic
+        thr     <- thr * (density > target ? grow : shrink)    # adaptive
+
+    Per-step movement is bounded by n_devices * thr per coordinate, which is
+    what makes Strom encoding stable; error feedback guarantees nothing is
+    lost, only delayed. Momentum/Adam-class updaters belong on the
+    plain-psum path (ParallelWrapper) — the reference's gradient-sharing
+    mode has the same shape: the exchange carries updates, not gradients.
+    """
+
+    def __init__(self, loss_fn: Callable, updater, mesh, *, axis: str = "data",
+                 threshold: float = 1e-3, adaptive: bool = True,
+                 target_density: float = 0.01, adapt_rate: float = 1.05,
+                 residual_clip: float = 5.0):
+        from deeplearning4j_tpu.optimize.updaters import Sgd, get_updater
+
+        self.loss_fn = loss_fn
+        updater = get_updater(updater)
+        if not isinstance(updater, Sgd):
+            raise ValueError(
+                "EncodedGradientTrainer exchanges lr-scaled updates (Strom "
+                "encoding); use Sgd here — stateful updaters belong on the "
+                "plain-psum ParallelWrapper path")
+        self.lr = updater.lr
+        self.mesh = mesh
+        self.axis = axis
+        self.threshold = threshold
+        self.adaptive = adaptive
+        self.target_density = target_density
+        self.adapt_rate = adapt_rate
+        # ResidualClippingPostProcessor analog: unbounded error feedback lags
+        # the optimizer by arbitrarily many steps and oscillates; the
+        # reference clips stored residuals every few iterations for the same
+        # reason. Clip to ±residual_clip * thr (0 disables).
+        self.residual_clip = residual_clip
+        self._step = None
+
+    def init(self, params):
+        # residuals are device-local (the reference's accumulator state is
+        # per-worker too) — carried with a leading device axis, sharded over
+        # the mesh axis, so the SPMD step sees its own residual block
+        n_dev = self.mesh.shape[self.axis]
+        return {
+            "params": params,
+            "residual": jax.tree_util.tree_map(
+                lambda p: jnp.zeros((n_dev,) + p.shape, p.dtype), params),
+            "thr": jnp.asarray(self.threshold, jnp.float32),
+            "step": jnp.asarray(0, jnp.int32),
+        }
+
+    def _build(self, carry):
+        loss_fn = self.loss_fn
+        axis = self.axis
+        adaptive = self.adaptive
+        target = self.target_density
+        rate = self.adapt_rate
+        lr = self.lr
+
+        def local_step(carry, x, y):
+            params = carry["params"]
+            loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+            loss = lax.pmean(loss, axis)
+            thr = carry["thr"]
+            step_lr = lr(carry["step"]) if callable(lr) else lr
+            u = jax.tree_util.tree_map(
+                lambda gg, r: step_lr * gg + r[0], g, carry["residual"])
+            enc_res = jax.tree_util.tree_map(
+                lambda t: threshold_encode(t, thr), u)
+            encoded = jax.tree_util.tree_map(lambda t: t[0], enc_res,
+                                             is_leaf=lambda t: isinstance(t, tuple))
+            rclip = self.residual_clip
+            residual = jax.tree_util.tree_map(
+                lambda t: (jnp.clip(t[1], -rclip * thr, rclip * thr)[None]
+                           if rclip else t[1][None]),
+                enc_res, is_leaf=lambda t: isinstance(t, tuple))
+            shared = jax.tree_util.tree_map(lambda t: lax.psum(t, axis), encoded)
+            new_params = jax.tree_util.tree_map(lambda p, d: p - d, params, shared)
+            if adaptive:
+                dens = lax.pmean(message_density(encoded, thr), axis)
+                thr = jnp.where(dens > target, thr * rate, thr / rate)
+                thr = jnp.clip(thr, 1e-8, 1e2)
+            return {
+                "params": new_params,
+                "residual": residual,
+                "thr": thr,
+                "step": carry["step"] + 1,
+            }, loss
+
+        rep = P()
+        carry_in_specs = {
+            "params": jax.tree_util.tree_map(lambda _: rep, carry["params"]),
+            "residual": jax.tree_util.tree_map(lambda _: P(axis),
+                                               carry["residual"]),
+            "thr": rep,
+            "step": rep,
+        }
+        fn = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(carry_in_specs, P(axis), P(axis)),
+            out_specs=(carry_in_specs, rep),
+        )
+        return jax.jit(fn)
+
+    def fit_batch(self, carry, x, y):
+        """One encoded-exchange step over a global batch (sharded on ``axis``).
+        Returns (new_carry, loss)."""
+        if self._step is None:
+            self._step = self._build(carry)
+        return self._step(carry, jnp.asarray(x), jnp.asarray(y))

@@ -293,6 +293,9 @@ static long count_cols(const char* p, const char* end, char delim) {
 // instead of silently training on zeros.
 static float parse_field(const char* q, const char* field_end, long* bad) {
   while (q < field_end && (*q == ' ' || *q == '\t' || *q == '"')) ++q;
+  while (field_end > q && (field_end[-1] == ' ' || field_end[-1] == '\t' ||
+                           field_end[-1] == '"' || field_end[-1] == '\r'))
+    --field_end;
   char tmp[64];
   size_t len = static_cast<size_t>(field_end - q);
   if (len > 63) len = 63;
@@ -300,7 +303,9 @@ static float parse_field(const char* q, const char* field_end, long* bad) {
   tmp[len] = '\0';
   char* endp = nullptr;
   float v = std::strtof(tmp, &endp);
-  if (endp == tmp && len > 0) ++*bad;
+  // the whole (trimmed) field must parse — '3.5kg' is bad, '' is a legal
+  // empty field (zero-filled, like ragged rows)
+  if (len > 0 && endp != tmp + len) ++*bad;
   return v;
 }
 

@@ -250,3 +250,20 @@ def test_non_numeric_csv_rejected(tmp_path):
     path = tmp_path / "labeled.csv"
     path.write_text("1.0,2.0,setosa\n3.0,4.0,virginica\n")
     assert native_csv_parse(path) is None
+
+
+def test_trailing_garbage_csv_rejected(tmp_path):
+    from deeplearning4j_tpu.native import native_available, native_csv_parse
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    path = tmp_path / "g.csv"
+    path.write_text("1.0,3.5kg\n2.0,4.0\n")
+    assert native_csv_parse(path) is None
+    # but quoted + padded numerics still parse fully natively
+    ok = tmp_path / "ok.csv"
+    ok.write_text('" 1.5 ", "2.5"\n"3.5", "4.5"\n')
+    import numpy as np
+
+    arr = native_csv_parse(ok)
+    np.testing.assert_allclose(arr, [[1.5, 2.5], [3.5, 4.5]])

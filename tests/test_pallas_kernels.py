@@ -152,3 +152,16 @@ class TestPallasLRN:
         op = get_op("lrn")
         assert op.select(big).platform == "pallas"
         assert op.select(small).platform != "pallas"
+
+    def test_even_depth_matches_xla(self, rng):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.convolution import lrn as xla_lrn
+        from deeplearning4j_tpu.ops.pallas import pallas_lrn
+
+        x = jnp.asarray(rng.normal(size=(2, 4, 4, 32)).astype(np.float32))
+        for depth in (2, 3, 4, 5):
+            got = np.asarray(pallas_lrn(x, depth=depth))
+            want = np.asarray(xla_lrn(x, depth=depth))
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"depth={depth}")

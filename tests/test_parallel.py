@@ -374,3 +374,62 @@ class TestSequenceParallelExtended:
         for k in g_ref:
             np.testing.assert_allclose(np.asarray(g_sp[k]), np.asarray(g_ref[k]),
                                        rtol=1e-3, atol=1e-4, err_msg=k)
+
+
+class TestEncodedGradientSharing:
+    """EncodedGradientsAccumulator/ThresholdAlgorithm analog: ternary
+    threshold encoding with error feedback over the data axis."""
+
+    def test_encode_and_residual(self):
+        from deeplearning4j_tpu.parallel import threshold_encode
+
+        g = jnp.asarray([0.5, -0.002, 0.0009, -3.0, 0.001])
+        q, r = threshold_encode(g, 0.001)
+        np.testing.assert_allclose(np.asarray(q),
+                                   [0.001, -0.001, 0, -0.001, 0.001])
+        np.testing.assert_allclose(np.asarray(q + r), np.asarray(g), rtol=1e-6)
+
+    def test_trainer_converges_and_stays_synced(self, rng):
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+        from deeplearning4j_tpu.parallel import EncodedGradientTrainer
+
+        mesh = DeviceMesh(data=8)
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        Y = X @ true_w
+
+        def loss_fn(params, x, y):
+            return ((x @ params["w"] - y) ** 2).mean()
+
+        trainer = EncodedGradientTrainer(loss_fn, Sgd(lr=0.3), mesh.mesh,
+                                         threshold=5e-3, adaptive=False)
+        carry = trainer.init({"w": jnp.zeros((4, 1), jnp.float32)})
+        losses = []
+        for _ in range(400):
+            carry, loss = trainer.fit_batch(carry, X, Y)
+            losses.append(float(loss))
+        # error feedback means encoded training still converges
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+        np.testing.assert_allclose(np.asarray(carry["params"]["w"]), true_w,
+                                   atol=0.3)
+
+    def test_adaptive_threshold_tracks_density(self, rng):
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+        from deeplearning4j_tpu.parallel import EncodedGradientTrainer
+
+        mesh = DeviceMesh(data=8)
+        X = rng.normal(size=(32, 16)).astype(np.float32)
+        Y = rng.normal(size=(32, 1)).astype(np.float32)
+
+        def loss_fn(params, x, y):
+            return ((x @ params["w"] - y) ** 2).mean()
+
+        trainer = EncodedGradientTrainer(loss_fn, Sgd(lr=0.01), mesh.mesh,
+                                         threshold=1e-6,  # far too permissive
+                                         target_density=0.25)
+        carry = trainer.init({"w": jnp.zeros((16, 1), jnp.float32)})
+        thr0 = float(carry["thr"])
+        for _ in range(50):
+            carry, _ = trainer.fit_batch(carry, X, Y)
+        # density >> target at thr=1e-6, so the threshold must have grown
+        assert float(carry["thr"]) > thr0 * 5

@@ -282,3 +282,88 @@ class TestToSameDiff:
         sd2 = SameDiff.load(p)
         np.testing.assert_allclose(np.asarray(sd2.output("pool", x=x)), want,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestBertClassOps:
+    """The op set a frozen BERT-style graph needs: embedding gather, batched
+    matmul attention, decomposed layer norm (SquaredDifference/Rsqrt), erf
+    gelu."""
+
+    def test_embedding_attention_block(self, rng):
+        V, D, T = 11, 4, 3
+        table = rng.normal(size=(V, D)).astype(np.float32)
+        g = graph_def(
+            node("ids", "Placeholder"),
+            node("table", "Const", value=_attr("value", t=table)),
+            node("axis0", "Const", value=_attr("value", t=np.asarray([0], np.int32))),
+            node("emb", "GatherV2", ["table", "ids", "axis0"]),
+            # scores = emb @ emb^T (adj_y), softmaxed, applied to emb
+            node("scores", "BatchMatMulV2", ["emb", "emb"],
+                 adj_y=_attr("adj_y", b=True)),
+            node("probs", "Softmax", ["scores"]),
+            node("ctx", "BatchMatMulV2", ["probs", "emb"]),
+        )
+        imported = TFGraphMapper.import_graph(g)
+        ids = rng.integers(0, V, (2, T)).astype(np.int32)
+        out = np.asarray(imported.output({"ids": ids}, ["ctx"]))
+
+        emb = table[ids]
+        scores = emb @ np.swapaxes(emb, -1, -2)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, probs @ emb, rtol=1e-4, atol=1e-5)
+
+    def test_decomposed_layernorm_and_gelu(self, rng):
+        D = 6
+        gamma = (rng.random(D) + 0.5).astype(np.float32)
+        beta = rng.normal(size=D).astype(np.float32)
+        x = rng.normal(size=(3, D)).astype(np.float32)
+        g = graph_def(
+            node("x", "Placeholder"),
+            node("gamma", "Const", value=_attr("value", t=gamma)),
+            node("beta", "Const", value=_attr("value", t=beta)),
+            node("axes", "Const", value=_attr("value", t=np.asarray([1], np.int32))),
+            node("mu", "Mean", ["x", "axes"], keep_dims=_attr("keep_dims", b=True)),
+            node("sqd", "SquaredDifference", ["x", "mu"]),
+            node("var", "Mean", ["sqd", "axes"], keep_dims=_attr("keep_dims", b=True)),
+            node("eps", "Const", value=_attr("value", t=np.asarray([1e-6], np.float32))),
+            node("vare", "Add", ["var", "eps"]),
+            node("inv", "Rsqrt", ["vare"]),
+            node("xmu", "Sub", ["x", "mu"]),
+            node("norm", "Mul", ["xmu", "inv"]),
+            node("scaled", "Mul", ["norm", "gamma"]),
+            node("ln", "Add", ["scaled", "beta"]),
+            # erf-gelu: 0.5 * ln * (1 + erf(ln / sqrt(2)))
+            node("rt2", "Const", value=_attr("value",
+                                             t=np.asarray([1.4142135], np.float32))),
+            node("div", "RealDiv", ["ln", "rt2"]),
+            node("erf", "Erf", ["div"]),
+            node("one", "Const", value=_attr("value", t=np.asarray([1.0], np.float32))),
+            node("erf1", "Add", ["erf", "one"]),
+            node("half", "Const", value=_attr("value", t=np.asarray([0.5], np.float32))),
+            node("xh", "Mul", ["ln", "half"]),
+            node("gelu", "Mul", ["xh", "erf1"]),
+        )
+        imported = TFGraphMapper.import_graph(g)
+        out = np.asarray(imported.output({"x": x}, ["gelu"]))
+
+        mu = x.mean(1, keepdims=True)
+        var = ((x - mu) ** 2).mean(1, keepdims=True)
+        ln = (x - mu) / np.sqrt(var + 1e-6) * gamma + beta
+        from scipy.special import erf as np_erf
+
+        want = 0.5 * ln * (1 + np_erf(ln / np.sqrt(2)))
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_strided_slice_and_cast(self, rng):
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        g = graph_def(
+            node("x", "Placeholder"),
+            node("b", "Const", value=_attr("value", t=np.asarray([1, 0], np.int32))),
+            node("e", "Const", value=_attr("value", t=np.asarray([3, 6], np.int32))),
+            node("s", "Const", value=_attr("value", t=np.asarray([1, 2], np.int32))),
+            node("sl", "StridedSlice", ["x", "b", "e", "s"]),
+            node("c", "Cast", ["sl"], DstT=_attr("DstT", type_=3)),
+        )
+        out = np.asarray(TFGraphMapper.import_graph(g).output({"x": x}, ["c"]))
+        np.testing.assert_array_equal(out, x[1:3, ::2].astype(np.int32))
