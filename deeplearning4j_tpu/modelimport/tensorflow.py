@@ -461,7 +461,10 @@ _TF_CAST_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 9: jnp.int64,
 @tf_op("Cast")
 def _cast(node, xs):
     dst = node.attr("DstT")
-    return xs[0].astype(_TF_CAST_DTYPES.get(dst.type if dst else 1, jnp.float32))
+    code = dst.type if dst else 1
+    if code not in _TF_CAST_DTYPES:
+        raise NotImplementedError(f"Cast to TF dtype enum {code} is not supported")
+    return xs[0].astype(_TF_CAST_DTYPES[code])
 
 
 @tf_op("OneHot")

@@ -145,7 +145,9 @@ def load_native_lib() -> Optional[ctypes.CDLL]:
 
                 alt = None
                 try:
+                    # same dir as _SO: /tmp may be mounted noexec
                     with tempfile.NamedTemporaryFile(suffix=".so",
+                                                     dir=str(_SO.parent),
                                                      delete=False) as f:
                         alt = f.name
                     shutil.copy2(_SO, alt)

@@ -126,16 +126,24 @@ class EncodedGradientTrainer:
             thr = carry["thr"]
             step_lr = lr(carry["step"]) if callable(lr) else lr
             u = jax.tree_util.tree_map(
-                lambda gg, r: step_lr * gg + r[0], g, carry["residual"])
-            enc_res = jax.tree_util.tree_map(
-                lambda t: threshold_encode(t, thr), u)
-            encoded = jax.tree_util.tree_map(lambda t: t[0], enc_res,
-                                             is_leaf=lambda t: isinstance(t, tuple))
+                lambda gg, r: (step_lr * gg).astype(gg.dtype) + r[0],
+                g, carry["residual"])
+            # two passes rather than one tree of (q, r) tuples: tuples are
+            # ordinary pytree containers, so is_leaf=tuple would mangle any
+            # params tree that itself contains tuples. thr cast to the leaf
+            # dtype keeps bf16 state/exchange bf16.
+            encoded = jax.tree_util.tree_map(
+                lambda t: threshold_encode(t, thr.astype(t.dtype))[0], u)
             rclip = self.residual_clip
-            residual = jax.tree_util.tree_map(
-                lambda t: (jnp.clip(t[1], -rclip * thr, rclip * thr)[None]
-                           if rclip else t[1][None]),
-                enc_res, is_leaf=lambda t: isinstance(t, tuple))
+
+            def new_residual(t, q):
+                r = t - q
+                if rclip:
+                    r = jnp.clip(r, (-rclip * thr).astype(t.dtype),
+                                 (rclip * thr).astype(t.dtype))
+                return r[None]
+
+            residual = jax.tree_util.tree_map(new_residual, u, encoded)
             shared = jax.tree_util.tree_map(lambda t: lax.psum(t, axis), encoded)
             new_params = jax.tree_util.tree_map(lambda p, d: p - d, params, shared)
             if adaptive:

@@ -1,0 +1,41 @@
+"""LeNet on MNIST — the dl4j-examples LenetMnistExample analog
+(BASELINE config #1). One jitted XLA train step; ~99% test accuracy at
+full scale."""
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.optimize import Adam, ScoreIterationListener
+
+
+def build_model(seed: int = 123) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(lr=1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), strides=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), strides=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def main(batch_size: int = 128, epochs: int = 1, n_examples: int | None = None):
+    model = build_model()
+    model.set_listeners(ScoreIterationListener(50))
+    train = MnistDataSetIterator(batch_size, train=True, n_examples=n_examples)
+    test = MnistDataSetIterator(batch_size, train=False, n_examples=n_examples)
+    model.fit(train, epochs=epochs)
+    ev = model.evaluate(test)
+    print(ev.stats())
+    return ev
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""SameDiff define-then-run graphs: build symbolically, train, save,
+reload — the org.nd4j.autodiff.samediff quickstart analog."""
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def main(steps: int = 300, path: str = "/tmp/samediff_model.sdz"):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(128, 4)).astype(np.float32)
+    W_true = rng.normal(size=(4, 2)).astype(np.float32)
+    Y = np.tanh(X @ W_true)
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    w = sd.var("w", np.zeros((4, 2), np.float32))
+    pred = sd.tanh(x @ w, name="pred")
+    sd.set_loss(sd.mse(y, pred))
+    loss = sd.fit(updater=Adam(lr=0.05), steps=steps, x=X, y=Y)
+
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    out = np.asarray(sd2.output("pred", x=X[:4]))
+    print(f"final loss {loss:.5f}; reloaded prediction shape {out.shape}")
+    return loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Transfer learning: freeze a pretrained trunk, retrain a new head —
+the dl4j-examples TransferLearning (EditLastLayerOthersFrozen) analog."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer
+from deeplearning4j_tpu.nn.transferlearning import FineTuneConfiguration, TransferLearningBuilder
+from deeplearning4j_tpu.optimize import Adam
+
+
+def main(steps: int = 60, n_classes: int = 3):
+    # "pretrained" source model (stands in for a zoo download)
+    src_conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(lr=1e-3)).list()
+                .layer(ConvolutionLayer(n_out=8, kernel=(3, 3), activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2)))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(16, 16, 1))
+                .build())
+    source = MultiLayerNetwork(src_conf).init()
+
+    model = (TransferLearningBuilder(source)
+             .fine_tune_configuration(FineTuneConfiguration(updater=Adam(lr=5e-3)))
+             .set_feature_extractor(1)    # freeze conv trunk
+             .remove_output_layer()
+             .add_layer(OutputLayer(n_out=n_classes, activation="softmax",
+                                    loss="mcxent"))
+             .build())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16, 16, 1)).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, 64)]
+    first = last = model.fit_batch((x, y))
+    for _ in range(steps - 1):
+        last = model.fit_batch((x, y))
+    frozen_unchanged = np.allclose(np.asarray(model.params[0]["W"]),
+                                   np.asarray(source.params[0]["W"]))
+    print(f"loss {first:.3f} -> {last:.3f}; frozen trunk untouched: {frozen_unchanged}")
+    return first, last, frozen_unchanged
+
+
+if __name__ == "__main__":
+    main()

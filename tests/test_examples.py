@@ -1,0 +1,45 @@
+"""Smoke-run every example tiny (the reference CI runs dl4j-examples the
+same way)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+
+def test_lenet_mnist():
+    import lenet_mnist
+
+    ev = lenet_mnist.main(batch_size=64, epochs=1, n_examples=256)
+    assert ev.accuracy() > 0.2  # synthetic fallback data still learns some
+
+
+def test_char_rnn():
+    import char_rnn
+
+    loss, text = char_rnn.main(steps=150, timesteps=16, batch=8,
+                               sample_len=10, units=24)
+    # RnnOutputLayer scores sum over timesteps: untrained ~= T * ln(V) ~= 54
+    assert loss < 44.0 and len(text) == 11
+
+
+def test_transfer_learning():
+    import transfer_learning
+
+    first, last, frozen = transfer_learning.main(steps=40)
+    assert last < first
+    assert frozen
+
+
+def test_parallel_training():
+    import parallel_training
+
+    score = parallel_training.main(epochs=2)
+    assert score > 0
+
+
+def test_samediff_training(tmp_path):
+    import samediff_training
+
+    loss = samediff_training.main(steps=200, path=str(tmp_path / "m.sdz"))
+    assert loss < 0.05

@@ -433,3 +433,30 @@ class TestEncodedGradientSharing:
             carry, _ = trainer.fit_batch(carry, X, Y)
         # density >> target at thr=1e-6, so the threshold must have grown
         assert float(carry["thr"]) > thr0 * 5
+
+    def test_tuple_params_and_bf16_dtypes(self, rng):
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+        from deeplearning4j_tpu.parallel import EncodedGradientTrainer
+
+        mesh = DeviceMesh(data=8)
+        X = rng.normal(size=(32, 3)).astype(np.float32)
+        Y = rng.normal(size=(32, 1)).astype(np.float32)
+
+        # params tree CONTAINING a tuple + a bf16 leaf
+        def loss_fn(params, x, y):
+            w1, w2 = params["layers"]
+            h = jnp.tanh(x @ w1.astype(jnp.float32))
+            return ((h @ w2 - y) ** 2).mean()
+
+        p0 = {"layers": (jnp.zeros((3, 4), jnp.bfloat16),
+                         jnp.zeros((4, 1), jnp.float32))}
+        tr = EncodedGradientTrainer(loss_fn, Sgd(lr=0.05), mesh.mesh,
+                                    threshold=5e-3, adaptive=False)
+        carry = tr.init(p0)
+        for _ in range(5):
+            carry, loss = tr.fit_batch(carry, X, Y)
+        w1, w2 = carry["params"]["layers"]
+        assert w1.dtype == jnp.bfloat16    # dtype preserved, no f32 creep
+        assert w2.dtype == jnp.float32
+        assert carry["residual"]["layers"][0].dtype == jnp.bfloat16
+        assert np.isfinite(float(loss))
