@@ -454,8 +454,10 @@ def _softplus(node, xs):
     return jax.nn.softplus(xs[0])
 
 
-_TF_CAST_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 9: jnp.int64,
-                   10: jnp.bool_, 14: jnp.bfloat16}
+_TF_CAST_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 4: jnp.uint8,
+                   5: jnp.int16, 6: jnp.int8, 9: jnp.int64, 10: jnp.bool_,
+                   14: jnp.bfloat16, 17: jnp.uint16, 19: jnp.float16,
+                   22: jnp.uint32, 23: jnp.uint64}
 
 
 @tf_op("Cast")
@@ -563,7 +565,11 @@ class TFImportedGraph:
         """Execute the graph (InferenceSession.output analog)."""
         acts: Dict[str, object] = {}
         for name, const in self.constants.items():
-            acts[name] = jnp.asarray(const) if const.dtype != object else const
+            # keep constants as numpy: jnp ops convert them on use, while
+            # static-argument reads (gather axes, reshape shapes, slice
+            # bounds) stay concrete — jnp.asarray here would return a tracer
+            # under jit on current JAX, breaking int(np.asarray(...)) reads
+            acts[name] = const
         for name, val in feeds.items():
             acts[name] = jnp.asarray(val)
         for name in self.order:

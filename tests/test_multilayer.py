@@ -181,3 +181,35 @@ class TestSaveLoad:
         l1 = model.fit_batch((x, y))
         l2 = loaded.fit_batch((x, y))
         assert abs(l1 - l2) < 1e-5
+
+
+class TestDonationCorrectness:
+    """SURVEY §5 race-detection analog: XLA removes the data-race class, but
+    buffer donation must actually happen (perf contract) and donated buffers
+    must never be read afterwards (correctness contract — the moral
+    equivalent of the reference's workspace use-after-scope debug mode)."""
+
+    def test_train_step_donates_params(self, rng):
+        from deeplearning4j_tpu.nn import (
+            InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optimize import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(lr=0.1))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        model = MultiLayerNetwork(conf).init()
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+
+        model.fit_batch((x, y))  # compile + first donation
+        old_w = model.params[0]["W"]
+        model.fit_batch((x, y))
+        # the previous param buffer was donated into the step: deleted
+        assert old_w.is_deleted(), \
+            "train step no longer donates its param buffers"
+        # and the live params are intact and usable
+        assert np.isfinite(np.asarray(model.params[0]["W"])).all()

@@ -367,3 +367,99 @@ class TestBertClassOps:
         )
         out = np.asarray(TFGraphMapper.import_graph(g).output({"x": x}, ["c"]))
         np.testing.assert_array_equal(out, x[1:3, ::2].astype(np.int32))
+
+
+class TestMiniBertEndToEnd:
+    """BASELINE config #4's shape: a BERT-style frozen graph — embedding
+    gather, scaled dot-product attention (BatchMatMul), residual + decomposed
+    LayerNorm, [CLS] pooler via StridedSlice shrink, tanh pooler dense,
+    classifier — imported and executed against a numpy reference."""
+
+    def test_mini_bert_graph(self, rng):
+        V, D, T, C = 13, 8, 5, 3
+        table = (rng.normal(size=(V, D)) * 0.5).astype(np.float32)
+        pos = (rng.normal(size=(1, T, D)) * 0.1).astype(np.float32)
+        Wq = rng.normal(size=(1, D, D)).astype(np.float32) * 0.4
+        Wk = rng.normal(size=(1, D, D)).astype(np.float32) * 0.4
+        Wv = rng.normal(size=(1, D, D)).astype(np.float32) * 0.4
+        gamma = (rng.random(D) + 0.5).astype(np.float32)
+        beta = rng.normal(size=D).astype(np.float32)
+        Wp = rng.normal(size=(D, D)).astype(np.float32) * 0.4
+        Wc = rng.normal(size=(D, C)).astype(np.float32) * 0.4
+        scale = np.asarray([1.0 / np.sqrt(D)], np.float32)
+
+        g = graph_def(
+            node("ids", "Placeholder"),
+            node("table", "Const", value=_attr("value", t=table)),
+            node("pos", "Const", value=_attr("value", t=pos)),
+            node("ax0", "Const", value=_attr("value", t=np.asarray([0], np.int32))),
+            node("emb0", "GatherV2", ["table", "ids", "ax0"]),
+            node("emb", "Add", ["emb0", "pos"]),
+            node("Wq", "Const", value=_attr("value", t=Wq)),
+            node("Wk", "Const", value=_attr("value", t=Wk)),
+            node("Wv", "Const", value=_attr("value", t=Wv)),
+            node("q", "BatchMatMulV2", ["emb", "Wq"]),
+            node("k", "BatchMatMulV2", ["emb", "Wk"]),
+            node("v", "BatchMatMulV2", ["emb", "Wv"]),
+            node("scores0", "BatchMatMulV2", ["q", "k"],
+                 adj_y=_attr("adj_y", b=True)),
+            node("scale", "Const", value=_attr("value", t=scale)),
+            node("scores", "Mul", ["scores0", "scale"]),
+            node("probs", "Softmax", ["scores"]),
+            node("ctx", "BatchMatMulV2", ["probs", "v"]),
+            node("res", "Add", ["emb", "ctx"]),
+            # decomposed layer norm
+            node("axes", "Const", value=_attr("value", t=np.asarray([2], np.int32))),
+            node("mu", "Mean", ["res", "axes"], keep_dims=_attr("keep_dims", b=True)),
+            node("sqd", "SquaredDifference", ["res", "mu"]),
+            node("var", "Mean", ["sqd", "axes"], keep_dims=_attr("keep_dims", b=True)),
+            node("eps", "Const", value=_attr("value", t=np.asarray([1e-6], np.float32))),
+            node("vare", "Add", ["var", "eps"]),
+            node("inv", "Rsqrt", ["vare"]),
+            node("xmu", "Sub", ["res", "mu"]),
+            node("norm", "Mul", ["xmu", "inv"]),
+            node("gamma", "Const", value=_attr("value", t=gamma)),
+            node("beta", "Const", value=_attr("value", t=beta)),
+            node("scaled", "Mul", ["norm", "gamma"]),
+            node("ln", "Add", ["scaled", "beta"]),
+            # [CLS] pooler: x[:, 0] via StridedSlice shrink on axis 1
+            node("sb", "Const", value=_attr("value", t=np.asarray([0, 0], np.int32))),
+            node("se", "Const", value=_attr("value", t=np.asarray([0, 1], np.int32))),
+            node("ss", "Const", value=_attr("value", t=np.asarray([1, 1], np.int32))),
+            node("cls", "StridedSlice", ["ln", "sb", "se", "ss"],
+                 begin_mask=_attr("begin_mask", i=1),
+                 end_mask=_attr("end_mask", i=1),
+                 shrink_axis_mask=_attr("shrink_axis_mask", i=2)),
+            node("Wp", "Const", value=_attr("value", t=Wp)),
+            node("pooled0", "MatMul", ["cls", "Wp"]),
+            node("pooled", "Tanh", ["pooled0"]),
+            node("Wc", "Const", value=_attr("value", t=Wc)),
+            node("logits", "MatMul", ["pooled", "Wc"]),
+            node("out", "Softmax", ["logits"]),
+        )
+        imported = TFGraphMapper.import_graph(g)
+        ids = rng.integers(0, V, (2, T)).astype(np.int32)
+        got = np.asarray(imported.output({"ids": ids}, ["out"]))
+
+        # numpy reference
+        emb = table[ids] + pos
+        q, k, v = emb @ Wq[0], emb @ Wk[0], emb @ Wv[0]
+        scores = q @ np.swapaxes(k, -1, -2) / np.sqrt(D)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        res = emb + probs @ v
+        mu = res.mean(-1, keepdims=True)
+        var = ((res - mu) ** 2).mean(-1, keepdims=True)
+        ln = (res - mu) / np.sqrt(var + 1e-6) * gamma + beta
+        pooled = np.tanh(ln[:, 0] @ Wp)
+        logits = pooled @ Wc
+        ee = np.exp(logits - logits.max(-1, keepdims=True))
+        want = ee / ee.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+        # jittable end to end
+        import jax
+
+        fn = imported.as_function(["out"])
+        got_jit = np.asarray(jax.jit(lambda i: fn(ids=i))(ids))
+        np.testing.assert_allclose(got_jit, want, rtol=2e-4, atol=2e-5)
