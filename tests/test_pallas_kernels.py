@@ -165,3 +165,55 @@ class TestPallasLRN:
             want = np.asarray(xla_lrn(x, depth=depth))
             np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
                                        err_msg=f"depth={depth}")
+
+
+class TestLayerPathSelection:
+    def test_transformer_layer_reaches_flash_kernel(self, rng, monkeypatch):
+        """The cuDNN-helper pattern end-to-end: a plain TransformerEncoderLayer
+        on a long unmasked sequence must route its attention through the
+        Pallas flash kernel via the registry (not the pinned XLA lowering)."""
+
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import TransformerEncoderLayer
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        op_obj = get_op("dot_product_attention")
+        impl = next(im for im in op_obj.impls if im.platform == "pallas")
+        calls = []
+        orig_fn = impl.fn
+
+        def spy(*a, **k):
+            calls.append(1)
+            return orig_fn(*a, **k)
+
+        monkeypatch.setattr(impl, "fn", spy)
+        T, H, Dh = 512, 2, 128
+        D = H * Dh
+        layer = TransformerEncoderLayer(d_model=D, n_heads=H)
+        params, state = layer.init(jax.random.key(0), InputType.recurrent(D, T))
+        x = jnp.asarray(rng.normal(size=(1, T, D)).astype(np.float32))
+        out, _ = layer.apply(params, state, x)
+        assert out.shape == (1, T, D)
+        assert calls, "flash kernel was not selected from the layer path"
+
+    def test_masked_attention_safe_under_force_pallas(self, rng, monkeypatch):
+        """Masked layer attention must stay on the XLA lowering even when
+        DL4J_TPU_FORCE_PALLAS forces the registry's pallas impls."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.common.env import env
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+        monkeypatch.setattr(env, "force_pallas", True)
+        T, D = 8, 8
+        layer = SelfAttentionLayer(n_out=D, n_heads=2)
+        params, state = layer.init(jax.random.key(0), InputType.recurrent(D, T))
+        x = jnp.asarray(rng.normal(size=(2, T, D)).astype(np.float32))
+        mask = jnp.asarray(np.array([[1] * 5 + [0] * 3, [1] * 8], np.float32))
+        out, _ = layer.apply(params, state, x, mask=mask)
+        assert np.isfinite(np.asarray(out)).all()
