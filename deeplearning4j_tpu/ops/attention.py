@@ -53,11 +53,9 @@ def multi_head_attention(x_q, x_kv, Wq, Wk, Wv, Wo, *, n_heads, mask=None, causa
     def split(t, T):
         return t.reshape(B, T, n_heads, Dh).transpose(0, 2, 1, 3)
 
-    # maskless calls go through the registry so the Pallas flash kernel's
-    # predicate is consulted; masked calls pin the XLA lowering directly —
-    # the flash kernel rejects masks, and under DL4J_TPU_FORCE_PALLAS the
-    # registry would force it onto them
-    attn = dot_product_attention if mask is not None else op("dot_product_attention")
-    o = attn(split(q, Tq), split(k, Tk), split(v, Tk), mask=mask, causal=causal)
+    # through the registry so the Pallas flash kernel is reachable; its
+    # `requires` rejects masked/misaligned-causal calls even under FORCE_PALLAS
+    o = op("dot_product_attention")(split(q, Tq), split(k, Tk), split(v, Tk),
+                                    mask=mask, causal=causal)
     o = o.transpose(0, 2, 1, 3).reshape(B, Tq, n_heads * Dh)
     return o @ Wo + (0 if bo is None else bo)

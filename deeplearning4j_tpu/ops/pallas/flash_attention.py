@@ -38,34 +38,41 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-    k = k_ref[0].astype(jnp.float32)                  # [bk, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # [bq, bk]
-    kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                   (block_q, block_k), 1)
-    # mask the ragged tail block (out-of-bounds key columns read padding)
-    s = jnp.where(kpos < seq_k, s, -jnp.inf)
-    if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 0)
-        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    # causal block skipping: a k-block whose first key is past this q-block's
+    # last query contributes nothing — skip its FLOPs entirely (roughly
+    # halves the causal work; the standard flash-attention optimization)
+    visible = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
 
-    m_prev = m_scr[:]                                  # [bq, 1]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-    # all-masked rows keep m=-inf; guard the exp
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-    l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
-    v = v_ref[0].astype(jnp.float32)
-    # zero padded tail rows of v: 0-weight x NaN-padding would poison the dot
-    vrow = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
-    v = jnp.where(vrow < seq_k, v, 0.0)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 1)
+        # mask the ragged tail block (out-of-bounds key columns read padding)
+        s = jnp.where(kpos < seq_k, s, -jnp.inf)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (block_q, block_k), 0)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+
+        m_prev = m_scr[:]                                  # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        # all-masked rows keep m=-inf; guard the exp
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_scr[:] = l_scr[:] * corr + p.sum(axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        # zero padded tail rows of v: 0-weight x NaN-padding would poison the dot
+        vrow = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        v = jnp.where(vrow < seq_k, v, 0.0)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -148,11 +155,19 @@ def flash_attention(q, k, v, *, mask=None, scale=None, causal=False,
     return _flash(q, k, v, causal, float(scale), block_q, block_k)
 
 
+def _flash_requires(q, k, v, *, mask=None, scale=None, causal=False, **kw):
+    # structural: the kernel cannot express masks, and its causal mask is
+    # start-aligned (query i sees keys <= i) which only matches the XLA
+    # lowering's end-aligned tril when Tq == Tk
+    return mask is None and (not causal or q.shape[-2] == k.shape[-2])
+
+
 def _flash_applicable(q, k, v, *, mask=None, scale=None, causal=False, **kw):
-    # long-sequence, unmasked, head_dim lane-aligned
-    return (mask is None and q.shape[-2] >= 512 and q.shape[-1] % 128 == 0
+    # perf heuristic: long-sequence, lane/block-aligned shapes
+    return (q.shape[-2] >= 512 and q.shape[-1] % 128 == 0
             and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0)
 
 
 register_impl("dot_product_attention", platform="pallas",
-              predicate=_flash_applicable, priority=1)(flash_attention)
+              predicate=_flash_applicable, requires=_flash_requires,
+              priority=1)(flash_attention)

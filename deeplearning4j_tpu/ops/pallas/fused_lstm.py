@@ -105,12 +105,16 @@ def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
     return jnp.swapaxes(out, 0, 1), (hT, cT)
 
 
+def _lstm_requires(x, h0, c0, W, R, b, *, peephole=None, **kw):
+    # structural: the kernel has no peephole terms (GravesLSTM stays on scan)
+    return peephole is None
+
+
 def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
-    # peepholes (GravesLSTM) stay on the scan path; kernel wants lane-aligned
-    # hidden size and a batch that fits a VMEM tile
+    # perf heuristic: lane-aligned hidden size, batch fits a VMEM tile
     H = R.shape[0]
-    return (peephole is None and H % 128 == 0 and x.shape[0] % 8 == 0)
+    return H % 128 == 0 and x.shape[0] % 8 == 0
 
 
 register_impl("lstm_layer", platform="pallas", predicate=_lstm_applicable,
-              priority=1)(fused_lstm_layer)
+              requires=_lstm_requires, priority=1)(fused_lstm_layer)

@@ -35,16 +35,27 @@ class OpImpl:
     name: str
     platform: str  # "xla" | "pallas"
     fn: Callable[..., Any]
-    predicate: Callable[..., bool] | None = None
+    predicate: Callable[..., bool] | None = None   # perf heuristic (FORCE_PALLAS bypasses)
+    requires: Callable[..., bool] | None = None    # structural: ALWAYS enforced
     priority: int = 0  # higher wins among applicable impls
 
-    def applicable(self, *args, **kwargs) -> bool:
-        if self.predicate is None:
+    def _check(self, pred, *args, **kwargs) -> bool:
+        if pred is None:
             return True
         try:
-            return bool(self.predicate(*args, **kwargs))
+            return bool(pred(*args, **kwargs))
         except Exception:
             return False
+
+    def supported(self, *args, **kwargs) -> bool:
+        """Structural applicability — the impl can produce a correct answer
+        for this call at all (e.g. flash attention cannot take a mask). Not
+        bypassed by FORCE_PALLAS."""
+        return self._check(self.requires, *args, **kwargs)
+
+    def applicable(self, *args, **kwargs) -> bool:
+        return (self.supported(*args, **kwargs)
+                and self._check(self.predicate, *args, **kwargs))
 
 
 class _Op:
@@ -66,7 +77,13 @@ class _Op:
             candidates = [
                 i
                 for i in self.impls
-                if i.platform != "xla" and (env.force_pallas or i.applicable(*args, **kwargs))
+                if i.platform != "xla"
+                and (i.applicable(*args, **kwargs)
+                     if not env.force_pallas
+                     # FORCE_PALLAS overrides perf heuristics, never
+                     # structural requirements — forcing an impl onto a call
+                     # it cannot express would trade speed for wrong answers
+                     else i.supported(*args, **kwargs))
             ]
             if candidates:
                 return max(candidates, key=lambda i: i.priority)
@@ -101,16 +118,20 @@ def register_op(name: str):
     return deco
 
 
-def register_impl(name: str, platform: str = "pallas", predicate=None, priority: int = 1):
+def register_impl(name: str, platform: str = "pallas", predicate=None,
+                  requires=None, priority: int = 1):
     """Decorator: register an accelerated implementation of op ``name``.
 
-    ``predicate(*call_args, **call_kwargs)`` gates applicability — the
-    TPU-native ``isUsablePlatform``.
+    ``predicate(*call_args, **call_kwargs)`` gates applicability on perf
+    heuristics (the TPU-native ``isUsablePlatform``); FORCE_PALLAS bypasses
+    it. ``requires`` states structural constraints the impl cannot operate
+    without (unsupported arguments, shape contracts) — never bypassed.
     """
 
     def deco(fn):
         get_op(name).impls.append(
-            OpImpl(name=name, platform=platform, fn=fn, predicate=predicate, priority=priority)
+            OpImpl(name=name, platform=platform, fn=fn, predicate=predicate,
+                   requires=requires, priority=priority)
         )
         return fn
 

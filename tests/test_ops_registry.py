@@ -88,3 +88,43 @@ def test_softmax_ce_from_logits_matches_probs():
     a = get_loss("mcxent")(labels, jax.nn.softmax(logits), from_logits=False)
     b = get_loss("mcxent")(labels, logits, from_logits=True)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4)
+
+
+class TestStructuralRequires:
+    """FORCE_PALLAS bypasses perf heuristics but never structural
+    requirements — forcing an impl onto a call it cannot express would give
+    wrong answers, not speed."""
+
+    def test_force_respects_requires(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.common.env import env
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        monkeypatch.setattr(env, "force_pallas", True)
+        op = get_op("dot_product_attention")
+        q = jnp.zeros((1, 1, 64, 32), jnp.float32)  # short, misaligned
+        # heuristic fails but structure OK -> forced onto pallas
+        assert op.select(q, q, q).platform == "pallas"
+        # masked: structurally impossible -> xla even under force
+        m = jnp.ones((1, 1, 64, 64))
+        assert op.select(q, q, q, mask=m).platform == "xla"
+        # causal cross-attention (Tq != Tk): structurally unsupported
+        k = jnp.zeros((1, 1, 128, 32), jnp.float32)
+        assert op.select(q, k, k, causal=True).platform == "xla"
+
+    def test_lstm_peephole_structural(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.common.env import env
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        monkeypatch.setattr(env, "force_pallas", True)
+        op = get_op("lstm_layer")
+        x = jnp.zeros((8, 4, 16))
+        h0 = c0 = jnp.zeros((8, 128))
+        W, R, b = jnp.zeros((16, 512)), jnp.zeros((128, 512)), jnp.zeros(512)
+        assert op.select(x, h0, c0, W, R, b).platform == "pallas"
+        # peephole is a structural no -> scan path even under force
+        assert op.select(x, h0, c0, W, R, b,
+                         peephole=jnp.zeros(384)).platform == "xla"
