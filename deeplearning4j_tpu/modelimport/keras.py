@@ -178,15 +178,36 @@ class KerasModelImport:
     """KerasModelImport.importKerasSequentialModelAndWeights analog."""
 
     @staticmethod
-    def import_model(h5_path: str) -> MultiLayerNetwork:
+    def import_model(h5_path: str):
         import h5py
 
         with h5py.File(h5_path, "r") as f:
             raw = f.attrs["model_config"]
             cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
-            model = KerasModelImport._build(cfg)
-            KerasModelImport._load_weights(model, f, cfg)
+            if cfg["class_name"] in ("Functional", "Model") and \
+                    KerasModelImport._is_nonlinear(cfg):
+                model = KerasModelImport._build_graph(cfg)
+                KerasModelImport._load_weights_graph(model, f)
+            else:
+                model = KerasModelImport._build(cfg)
+                KerasModelImport._load_weights(model, f, cfg)
         return model
+
+    @staticmethod
+    def _is_nonlinear(cfg: dict) -> bool:
+        """Functional models with branches/merges need a ComputationGraph;
+        linear chains keep the simpler MultiLayerNetwork import."""
+        for lc in cfg["config"]["layers"]:
+            nodes = lc.get("inbound_nodes") or []
+            if nodes and len(nodes[0]) > 1:
+                return True  # multi-input layer (merge)
+        # multiple consumers of one output?
+        consumed: dict = {}
+        for lc in cfg["config"]["layers"]:
+            for n in (lc.get("inbound_nodes") or [[]])[0]:
+                consumed[n[0]] = consumed.get(n[0], 0) + 1
+        return any(c > 1 for c in consumed.values()) or \
+            len(cfg["config"].get("output_layers", [])) > 1
 
     # ------------------------------------------------------------- topology
     @staticmethod
@@ -234,10 +255,75 @@ class KerasModelImport:
         model._keras_names = keras_names
         return model
 
-    # -------------------------------------------------------------- weights
+    # ---------------------------------------------------- functional -> DAG
     @staticmethod
-    def _load_weights(model: MultiLayerNetwork, f, cfg: dict):
-        import jax.numpy as jnp
+    def _build_graph(cfg: dict):
+        """Keras Functional topology -> ComputationGraph.
+
+        Reference analog: KerasModel (non-sequential path) in
+        org.deeplearning4j.nn.modelimport.keras — inbound_nodes become
+        vertex edges; Add/Multiply/Average/Concatenate merge layers map onto
+        ElementWiseVertex/MergeVertex."""
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        mapper = KerasLayerMapper()
+        gb = NeuralNetConfiguration.builder().updater(Adam(lr=1e-3)).graph_builder()
+        input_types = {}
+        keras_names = []
+        outputs = [o[0] for o in cfg["config"]["output_layers"]]
+
+        for lc in cfg["config"]["layers"]:
+            kcls = lc["class_name"]
+            kcfg = lc["config"]
+            name = lc.get("name") or kcfg["name"]
+            inbound = [n[0] for n in (lc.get("inbound_nodes") or [[]])[0]]
+            if kcls == "InputLayer":
+                gb = gb.add_inputs(name)
+                shape = kcfg.get("batch_input_shape") or kcfg.get("batch_shape")
+                input_types[name] = _input_type_from_shape(shape)
+                continue
+            if kcls in ("Add", "Multiply", "Average", "Maximum", "Subtract"):
+                opname = {"Add": "add", "Multiply": "mul", "Average": "average",
+                          "Maximum": "max", "Subtract": "subtract"}[kcls]
+                gb = gb.add_vertex(name, ElementWiseVertex(op=opname), *inbound)
+                continue
+            if kcls == "Concatenate":
+                axis = kcfg.get("axis", -1)
+                if axis not in (-1,):
+                    raise ValueError("Concatenate import supports axis=-1 only")
+                gb = gb.add_vertex(name, MergeVertex(), *inbound)
+                continue
+            layer = mapper.map(kcls, kcfg)
+            if layer is None:
+                # passthroughs still need a vertex so later layers can
+                # reference the name; Flatten gets an explicit preprocessor
+                # (auto ones only fire before Dense/Output layers, not when
+                # the flattened tensor feeds a merge vertex or the output)
+                layer = ActivationLayer(activation="identity")
+                if kcls == "Flatten":
+                    from deeplearning4j_tpu.nn.conf.preprocessors import (
+                        FlattenPreProcessor,
+                    )
+
+                    gb = gb.add_preprocessor(name, FlattenPreProcessor())
+            if name in outputs and isinstance(layer, DenseLayer) and \
+                    not isinstance(layer, OutputLayer):
+                loss = "mcxent" if layer.activation == "softmax" else (
+                    "xent" if layer.activation == "sigmoid" else "mse")
+                layer = OutputLayer(n_out=layer.n_out, activation=layer.activation,
+                                    loss=loss, has_bias=layer.has_bias)
+            gb = gb.add_layer(name, layer, *inbound)
+            keras_names.append(name)
+
+        conf = gb.set_input_types(**input_types).set_outputs(*outputs).build()
+        model = ComputationGraph(conf).init()
+        model._keras_names = keras_names
+        return model
+
+    @staticmethod
+    def _load_weights_graph(model, f):
+        from deeplearning4j_tpu.nn.conf.graph import LayerVertex
 
         wg = f["model_weights"]
 
@@ -249,56 +335,88 @@ class KerasModelImport:
                      for n in g.attrs.get("weight_names", [])]
             return [np.asarray(g[n]) for n in names]
 
-        from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+        for name, vertex in model.conf.vertices.items():
+            if not isinstance(vertex, LayerVertex):
+                continue
+            ws = arrays_for(name)
+            if not ws:
+                continue
+            KerasModelImport._copy_layer_weights(
+                vertex.layer, model.params.get(name, {}),
+                model.state.get(name, {}), ws)
+
+    # -------------------------------------------------------------- weights
+    @staticmethod
+    def _load_weights(model: MultiLayerNetwork, f, cfg: dict):
+        wg = f["model_weights"]
+
+        def arrays_for(name):
+            if name not in wg:
+                return []
+            g = wg[name]
+            names = [n.decode() if isinstance(n, bytes) else n
+                     for n in g.attrs.get("weight_names", [])]
+            return [np.asarray(g[n]) for n in names]
 
         for li, (layer, kname) in enumerate(zip(model.layers, model._keras_names)):
             ws = arrays_for(kname)
             if not ws:
                 continue
-            p = model.params[li]
-            if isinstance(layer, LastTimeStepLayer):
-                layer = layer.underlying  # params delegate to the wrapped RNN
-            if isinstance(layer, BidirectionalLayer):
-                KerasModelImport._load_bidirectional(layer, p, ws)
-            elif isinstance(layer, (DenseLayer,)) and "W" in p:
-                p["W"] = jnp.asarray(ws[0])
-                if layer.has_bias and len(ws) > 1:
-                    p["b"] = jnp.asarray(ws[1])
-            elif isinstance(layer, SeparableConvolution2DLayer):
-                p["dW"] = jnp.asarray(ws[0])  # (kh,kw,cin,mult)
-                p["pW"] = jnp.asarray(ws[1])  # (1,1,cin*mult,filters)
-                if layer.has_bias and len(ws) > 2:
-                    p["b"] = jnp.asarray(ws[2])
-            elif isinstance(layer, DepthwiseConvolution2DLayer):
-                p["W"] = jnp.asarray(ws[0])
-                if layer.has_bias and len(ws) > 1:
-                    p["b"] = jnp.asarray(ws[1])
-            elif isinstance(layer, Deconvolution2DLayer):
-                # keras Conv2DTranspose kernel is (kh, kw, out, in) with
-                # scatter (flipped) semantics; ours is lax.conv_transpose
-                # HWIO without the flip -> transpose dims + flip spatially
-                p["W"] = jnp.asarray(
-                    np.transpose(ws[0], (0, 1, 3, 2))[::-1, ::-1].copy())
-                if layer.has_bias and len(ws) > 1:
-                    p["b"] = jnp.asarray(ws[1])
-            elif isinstance(layer, ConvolutionLayer):
-                p["W"] = jnp.asarray(ws[0])  # keras HWIO == ours
-                if layer.has_bias and len(ws) > 1:
-                    p["b"] = jnp.asarray(ws[1])
-            elif isinstance(layer, LayerNormalizationLayer):
-                p["gamma"] = jnp.asarray(ws[0])
-                if len(ws) > 1:
-                    p["beta"] = jnp.asarray(ws[1])
-            elif isinstance(layer, BatchNormalizationLayer):
-                gamma, beta, mean, var = ws
-                p["gamma"] = jnp.asarray(gamma)
-                p["beta"] = jnp.asarray(beta)
-                model.state[li]["mean"] = jnp.asarray(mean)
-                model.state[li]["var"] = jnp.asarray(var)
-            elif isinstance(layer, (LSTMLayer, GRULayer, SimpleRnnLayer)):
-                KerasModelImport._load_rnn(layer, p, ws)
-            elif isinstance(layer, EmbeddingSequenceLayer):
-                p["W"] = jnp.asarray(ws[0])
+            KerasModelImport._copy_layer_weights(
+                layer, model.params[li], model.state[li], ws)
+
+    @staticmethod
+    def _copy_layer_weights(layer, p, state_entry, ws):
+        """Copy one Keras layer's weight list into a native layer's params
+        (+ running stats into state). Shared by the sequential and the
+        functional/ComputationGraph import paths."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.layers import LastTimeStepLayer
+
+        if isinstance(layer, LastTimeStepLayer):
+            layer = layer.underlying  # params delegate to the wrapped RNN
+        if isinstance(layer, BidirectionalLayer):
+            KerasModelImport._load_bidirectional(layer, p, ws)
+        elif isinstance(layer, (DenseLayer,)) and "W" in p:
+            p["W"] = jnp.asarray(ws[0])
+            if layer.has_bias and len(ws) > 1:
+                p["b"] = jnp.asarray(ws[1])
+        elif isinstance(layer, SeparableConvolution2DLayer):
+            p["dW"] = jnp.asarray(ws[0])  # (kh,kw,cin,mult)
+            p["pW"] = jnp.asarray(ws[1])  # (1,1,cin*mult,filters)
+            if layer.has_bias and len(ws) > 2:
+                p["b"] = jnp.asarray(ws[2])
+        elif isinstance(layer, DepthwiseConvolution2DLayer):
+            p["W"] = jnp.asarray(ws[0])
+            if layer.has_bias and len(ws) > 1:
+                p["b"] = jnp.asarray(ws[1])
+        elif isinstance(layer, Deconvolution2DLayer):
+            # keras Conv2DTranspose kernel is (kh, kw, out, in) with
+            # scatter (flipped) semantics; ours is lax.conv_transpose
+            # HWIO without the flip -> transpose dims + flip spatially
+            p["W"] = jnp.asarray(
+                np.transpose(ws[0], (0, 1, 3, 2))[::-1, ::-1].copy())
+            if layer.has_bias and len(ws) > 1:
+                p["b"] = jnp.asarray(ws[1])
+        elif isinstance(layer, ConvolutionLayer):
+            p["W"] = jnp.asarray(ws[0])  # keras HWIO == ours
+            if layer.has_bias and len(ws) > 1:
+                p["b"] = jnp.asarray(ws[1])
+        elif isinstance(layer, LayerNormalizationLayer):
+            p["gamma"] = jnp.asarray(ws[0])
+            if len(ws) > 1:
+                p["beta"] = jnp.asarray(ws[1])
+        elif isinstance(layer, BatchNormalizationLayer):
+            gamma, beta, mean, var = ws
+            p["gamma"] = jnp.asarray(gamma)
+            p["beta"] = jnp.asarray(beta)
+            state_entry["mean"] = jnp.asarray(mean)
+            state_entry["var"] = jnp.asarray(var)
+        elif isinstance(layer, (LSTMLayer, GRULayer, SimpleRnnLayer)):
+            KerasModelImport._load_rnn(layer, p, ws)
+        elif isinstance(layer, EmbeddingSequenceLayer):
+            p["W"] = jnp.asarray(ws[0])
 
     @staticmethod
     def _load_rnn(layer, p, ws):

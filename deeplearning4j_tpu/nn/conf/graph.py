@@ -234,6 +234,7 @@ class GraphBuilder:
         self._net_inputs: list[str] = []
         self._net_outputs: list[str] = []
         self._input_types: dict[str, InputType] = {}
+        self._preprocessors: dict[str, object] = {}
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._net_inputs.extend(names)
@@ -257,6 +258,13 @@ class GraphBuilder:
         self._net_outputs = list(names)
         return self
 
+    def add_preprocessor(self, name: str, preproc) -> "GraphBuilder":
+        """Attach an InputPreProcessor to a vertex (applied to its single
+        input before the vertex — ComputationGraphConfiguration
+        .inputPreProcessor analog)."""
+        self._preprocessors[name] = preproc
+        return self
+
     def build(self):
         from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
 
@@ -270,5 +278,6 @@ class GraphBuilder:
             updater=self._base._updater,
             dtype=self._base._dtype,
             max_grad_norm=self._base._max_grad_norm,
+            preprocessors=dict(self._preprocessors),
         )
         return conf.resolve() if self._input_types else conf

@@ -280,3 +280,167 @@ class TestKerasWideLayers:
         # configured keras alpha must be honored
         ref = np.where(pooled > 0, pooled, pooled * 0.3)
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def _write_functional_h5(path, layers_cfg, weights, inputs, outputs):
+    import h5py
+
+    cfg = {"class_name": "Functional",
+           "config": {"name": "model", "layers": layers_cfg,
+                      "input_layers": [[n, 0, 0] for n in inputs],
+                      "output_layers": [[n, 0, 0] for n in outputs]}}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(cfg)
+        wg = f.create_group("model_weights")
+        for lname, arrs in weights.items():
+            g = wg.create_group(lname)
+            names = []
+            for aname, arr in arrs:
+                full = f"{lname}/{aname}"
+                g.create_dataset(full, data=arr)
+                names.append(full.encode())
+            g.attrs["weight_names"] = names
+    return path
+
+
+def _fnode(name, cls, cfg, inbound):
+    return {"class_name": cls, "name": name,
+            "config": dict(cfg, name=name),
+            "inbound_nodes": [[[i, 0, 0, {}] for i in inbound]] if inbound else []}
+
+
+class TestKerasFunctionalGraph:
+    def test_residual_branch_merge(self, tmp_path, rng):
+        """input -> (dense_a, dense_b) -> Add -> softmax head: branches and a
+        merge — the topology the MultiLayerNetwork path cannot express."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        Wa = rng.normal(size=(6, 5)).astype(np.float32)
+        ba = rng.normal(size=(5,)).astype(np.float32)
+        Wb = rng.normal(size=(6, 5)).astype(np.float32)
+        bb = rng.normal(size=(5,)).astype(np.float32)
+        Wo = rng.normal(size=(5, 3)).astype(np.float32)
+        bo = np.zeros(3, np.float32)
+        layers = [
+            _fnode("in", "InputLayer", {"batch_input_shape": [None, 6]}, []),
+            _fnode("da", "Dense", {"units": 5, "activation": "relu",
+                                   "use_bias": True}, ["in"]),
+            _fnode("db", "Dense", {"units": 5, "activation": "tanh",
+                                   "use_bias": True}, ["in"]),
+            _fnode("add", "Add", {}, ["da", "db"]),
+            _fnode("out", "Dense", {"units": 3, "activation": "softmax",
+                                    "use_bias": True}, ["add"]),
+        ]
+        path = _write_functional_h5(tmp_path / "fn.h5", layers, {
+            "da": [("kernel:0", Wa), ("bias:0", ba)],
+            "db": [("kernel:0", Wb), ("bias:0", bb)],
+            "out": [("kernel:0", Wo), ("bias:0", bo)],
+        }, ["in"], ["out"])
+        model = KerasModelImport.import_model(str(path))
+        assert isinstance(model, ComputationGraph)
+
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        got = np.asarray(model.output(x))
+        h = np.maximum(x @ Wa + ba, 0) + np.tanh(x @ Wb + bb)
+        logits = h @ Wo + bo
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_concatenate_merge(self, tmp_path, rng):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        Wa = rng.normal(size=(4, 3)).astype(np.float32)
+        Wb = rng.normal(size=(4, 2)).astype(np.float32)
+        Wo = rng.normal(size=(5, 2)).astype(np.float32)
+        layers = [
+            _fnode("in", "InputLayer", {"batch_input_shape": [None, 4]}, []),
+            _fnode("da", "Dense", {"units": 3, "activation": "linear",
+                                   "use_bias": False}, ["in"]),
+            _fnode("db", "Dense", {"units": 2, "activation": "linear",
+                                   "use_bias": False}, ["in"]),
+            _fnode("cat", "Concatenate", {"axis": -1}, ["da", "db"]),
+            _fnode("out", "Dense", {"units": 2, "activation": "softmax",
+                                    "use_bias": False}, ["cat"]),
+        ]
+        path = _write_functional_h5(tmp_path / "cat.h5", layers, {
+            "da": [("kernel:0", Wa)],
+            "db": [("kernel:0", Wb)],
+            "out": [("kernel:0", Wo)],
+        }, ["in"], ["out"])
+        model = KerasModelImport.import_model(str(path))
+        assert isinstance(model, ComputationGraph)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        got = np.asarray(model.output(x))
+        h = np.concatenate([x @ Wa, x @ Wb], -1)
+        logits = h @ Wo
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_linear_functional_stays_mln(self, tmp_path, rng):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        W = rng.normal(size=(4, 2)).astype(np.float32)
+        layers = [
+            _fnode("in", "InputLayer", {"batch_input_shape": [None, 4]}, []),
+            _fnode("out", "Dense", {"units": 2, "activation": "softmax",
+                                    "use_bias": False}, ["in"]),
+        ]
+        path = _write_functional_h5(tmp_path / "lin.h5", layers, {
+            "out": [("kernel:0", W)],
+        }, ["in"], ["out"])
+        model = KerasModelImport.import_model(str(path))
+        assert isinstance(model, MultiLayerNetwork)
+
+    def test_subtract_merge(self, tmp_path, rng):
+        Wa = rng.normal(size=(4, 3)).astype(np.float32)
+        Wb = rng.normal(size=(4, 3)).astype(np.float32)
+        Wo = rng.normal(size=(3, 2)).astype(np.float32)
+        layers = [
+            _fnode("in", "InputLayer", {"batch_input_shape": [None, 4]}, []),
+            _fnode("da", "Dense", {"units": 3, "activation": "linear",
+                                   "use_bias": False}, ["in"]),
+            _fnode("db", "Dense", {"units": 3, "activation": "linear",
+                                   "use_bias": False}, ["in"]),
+            _fnode("sub", "Subtract", {}, ["da", "db"]),
+            _fnode("out", "Dense", {"units": 2, "activation": "softmax",
+                                    "use_bias": False}, ["sub"]),
+        ]
+        path = _write_functional_h5(tmp_path / "sub.h5", layers, {
+            "da": [("kernel:0", Wa)], "db": [("kernel:0", Wb)],
+            "out": [("kernel:0", Wo)],
+        }, ["in"], ["out"])
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        got = np.asarray(model.output(x))
+        logits = (x @ Wa - x @ Wb) @ Wo
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_flatten_into_merge(self, tmp_path, rng):
+        """Flatten feeding a Concatenate (not a Dense) must actually flatten."""
+        Wb = rng.normal(size=(12, 4)).astype(np.float32)
+        Wo = rng.normal(size=(16, 2)).astype(np.float32)
+        layers = [
+            _fnode("in", "InputLayer", {"batch_input_shape": [None, 2, 2, 3]}, []),
+            _fnode("fl", "Flatten", {}, ["in"]),
+            _fnode("db", "Dense", {"units": 4, "activation": "linear",
+                                   "use_bias": False}, ["fl"]),
+            _fnode("cat", "Concatenate", {"axis": -1}, ["fl", "db"]),
+            _fnode("out", "Dense", {"units": 2, "activation": "softmax",
+                                    "use_bias": False}, ["cat"]),
+        ]
+        path = _write_functional_h5(tmp_path / "fm.h5", layers, {
+            "db": [("kernel:0", Wb)], "out": [("kernel:0", Wo)],
+        }, ["in"], ["out"])
+        model = KerasModelImport.import_model(str(path))
+        x = rng.normal(size=(3, 2, 2, 3)).astype(np.float32)
+        got = np.asarray(model.output(x))
+        flat = x.reshape(3, 12)
+        h = np.concatenate([flat, flat @ Wb], -1)
+        logits = h @ Wo
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-5)
