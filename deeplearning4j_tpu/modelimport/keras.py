@@ -9,8 +9,9 @@ required gate/axis permutations (e.g. Keras LSTM gate order i,f,c,o ->
 our IFOG i,f,o,g).
 
 Sequential models -> MultiLayerNetwork; Functional models with linear
-topology -> MultiLayerNetwork, otherwise ComputationGraph [graph topology
-import: linear chains supported this round].
+topology -> MultiLayerNetwork, otherwise ComputationGraph (inbound_nodes
+become vertex edges; Add/Multiply/Average/Maximum/Subtract ->
+ElementWiseVertex, Concatenate -> MergeVertex).
 """
 
 from __future__ import annotations
