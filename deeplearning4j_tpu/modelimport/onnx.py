@@ -240,6 +240,178 @@ def _transpose(node, xs):
     return jnp.transpose(xs[0], perm or None)
 
 
+def _opt(xs, i):
+    """Positional optional input: None when absent or empty-named."""
+    return xs[i] if len(xs) > i and xs[i] is not None else None
+
+
+def _const_ints(node, xs, attr_name, input_idx):
+    """Int list from an attribute (older opsets) or a constant input tensor
+    (newer opsets); None if neither present."""
+    vals = node.ints(attr_name)
+    if vals:
+        return vals
+    t = _opt(xs, input_idx)
+    if t is None:
+        return None
+    return [int(v) for v in np.asarray(t).ravel()]
+
+
+@onnx_op("Gather")
+def _gather(node, xs):
+    a = node.attr("axis")
+    return jnp.take(xs[0], jnp.asarray(xs[1]).astype(jnp.int32),
+                    axis=a.i if a else 0)
+
+
+@onnx_op("Squeeze")
+def _squeeze(node, xs):
+    axes = _const_ints(node, xs, "axes", 1)
+    return jnp.squeeze(xs[0], axis=tuple(axes) if axes else None)
+
+
+@onnx_op("Unsqueeze")
+def _unsqueeze(node, xs):
+    axes = _const_ints(node, xs, "axes", 1)
+    out = xs[0]
+    out_rank = out.ndim + len(axes)
+    # axes are positions in the OUTPUT tensor, possibly negative
+    for ax in sorted(a % out_rank for a in axes):
+        out = jnp.expand_dims(out, ax)
+    return out
+
+
+@onnx_op("ReduceMean")
+def _reduce_mean(node, xs):
+    axes = _const_ints(node, xs, "axes", 1)
+    kd = node.attr("keepdims")
+    return jnp.mean(xs[0], axis=tuple(axes) if axes else None,
+                    keepdims=bool(kd.i) if kd is not None else True)
+
+
+@onnx_op("ReduceSum")
+def _reduce_sum(node, xs):
+    axes = _const_ints(node, xs, "axes", 1)
+    kd = node.attr("keepdims")
+    return jnp.sum(xs[0], axis=tuple(axes) if axes else None,
+                   keepdims=bool(kd.i) if kd is not None else True)
+
+
+@onnx_op("Pow")
+def _pow(node, xs):
+    return jnp.power(xs[0], xs[1])
+
+
+@onnx_op("Sqrt")
+def _sqrt(node, xs):
+    return jnp.sqrt(xs[0])
+
+
+@onnx_op("Erf")
+def _erf(node, xs):
+    return jax.scipy.special.erf(xs[0])
+
+
+@onnx_op("Neg")
+def _neg(node, xs):
+    return -xs[0]
+
+
+@onnx_op("Exp")
+def _exp(node, xs):
+    return jnp.exp(xs[0])
+
+
+@onnx_op("Log")
+def _log(node, xs):
+    return jnp.log(xs[0])
+
+
+@onnx_op("Clip")
+def _clip(node, xs):
+    lo = node.attr("min")
+    hi = node.attr("max")
+    lo_t, hi_t = _opt(xs, 1), _opt(xs, 2)
+    lo_v = lo.f if lo is not None else (
+        np.asarray(lo_t).ravel()[0] if lo_t is not None else None)
+    hi_v = hi.f if hi is not None else (
+        np.asarray(hi_t).ravel()[0] if hi_t is not None else None)
+    return jnp.clip(xs[0], lo_v, hi_v)
+
+
+@onnx_op("Where")
+def _where(node, xs):
+    return jnp.where(xs[0], xs[1], xs[2])
+
+
+@onnx_op("Equal")
+def _equal(node, xs):
+    return jnp.equal(xs[0], xs[1])
+
+
+@onnx_op("Expand")
+def _expand(node, xs):
+    shape = [int(v) for v in np.asarray(xs[1]).ravel()]
+    return jnp.broadcast_to(xs[0], jnp.broadcast_shapes(xs[0].shape,
+                                                        tuple(shape)))
+
+
+@onnx_op("Gelu")
+def _gelu(node, xs):
+    approx = node.attr("approximate")
+    tanh_approx = approx is not None and approx.s == "tanh"
+    return jax.nn.gelu(xs[0], approximate=tanh_approx)
+
+
+@onnx_op("LayerNormalization")
+def _layer_norm(node, xs):
+    eps = node.attr("epsilon")
+    eps_v = eps.f if eps is not None else 1e-5
+    ax = node.attr("axis")
+    axis = ax.i if ax is not None else -1
+    x = xs[0]
+    # ONNX normalizes over ALL trailing dims starting at `axis`
+    axes = tuple(range(axis % x.ndim, x.ndim))
+    mu = x.mean(axes, keepdims=True)
+    var = x.var(axes, keepdims=True)
+    out = (x - mu) / jnp.sqrt(var + eps_v)
+    if len(xs) > 1:
+        out = out * xs[1]
+    if len(xs) > 2:
+        out = out + xs[2]
+    return out
+
+
+@onnx_op("Split")
+def _split(node, xs):
+    ax = node.attr("axis")
+    axis = ax.i if ax is not None else 0
+    n = node.attr("num_outputs")
+    splits = _const_ints(node, xs, "split", 1)
+    if splits:
+        idx = np.cumsum(splits)[:-1].tolist()
+        return tuple(jnp.split(xs[0], idx, axis=axis))
+    # default: equal split into the node's output count (opset < 18)
+    parts = n.i if n is not None else len(node.outputs)
+    return tuple(jnp.split(xs[0], parts, axis=axis))
+
+
+@onnx_op("Pad")
+def _pad(node, xs):
+    mode = node.attr("mode")
+    mode_s = mode.s if mode is not None else "constant"
+    if mode_s not in ("constant", "reflect", "edge"):
+        raise NotImplementedError(f"Pad mode {mode_s!r} is not supported")
+    pads = _const_ints(node, xs, "pads", 1)
+    rank = xs[0].ndim
+    pairs = [(pads[i], pads[i + rank]) for i in range(rank)]
+    if mode_s == "constant":
+        cv = _opt(xs, 2)
+        const = float(np.asarray(cv).ravel()[0]) if cv is not None else 0.0
+        return jnp.pad(xs[0], pairs, constant_values=const)
+    return jnp.pad(xs[0], pairs, mode={"reflect": "reflect", "edge": "edge"}[mode_s])
+
+
 @onnx_op("Conv")
 def _conv(node, xs):
     x, w = xs[0], xs[1]  # x NCHW, w OIHW
@@ -313,8 +485,10 @@ class OnnxImportedGraph:
 
     def output(self, feeds: Dict[str, np.ndarray],
                outputs: Optional[List[str]] = None):
-        acts: Dict[str, object] = {k: jnp.asarray(v)
-                                   for k, v in self.initializers.items()}
+        # initializers stay numpy: jnp ops convert them on use, while static
+        # reads (axes, shapes, pads) stay concrete — jnp.asarray inside a jit
+        # trace returns a tracer on current JAX and would break them
+        acts: Dict[str, object] = dict(self.initializers)
         for k, v in feeds.items():
             acts[k] = jnp.asarray(v)
         for node in self.nodes:
@@ -323,7 +497,8 @@ class OnnxImportedGraph:
                 raise NotImplementedError(
                     f"ONNX op '{node.op}' (node {node.name}) has no mapper; "
                     f"register one with @onnx_op('{node.op}')")
-            xs = [acts[i] for i in node.inputs if i]
+            # empty names mark omitted optional inputs; keep positions
+            xs = [acts[i] if i else None for i in node.inputs]
             y = fn(node, xs)
             outs = node.outputs or [node.name]
             if isinstance(y, (list, tuple)):

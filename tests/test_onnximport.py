@@ -129,3 +129,116 @@ class TestOnnxConv:
         g = OnnxModelImport.import_model(model)
         with pytest.raises(NotImplementedError, match="FancyOp"):
             g.output({"x": np.zeros((1,), np.float32)})
+
+
+class TestTransformerClassOps:
+    """Transformer-graph op set: Gather embeddings, fused LayerNormalization,
+    erf Gelu, reductions, Clip/Where, Split."""
+
+    def test_gather_layernorm_gelu(self, rng):
+        V, D, T = 9, 6, 4
+        table = rng.normal(size=(V, D)).astype(np.float32)
+        gamma = (rng.random(D) + 0.5).astype(np.float32)
+        beta = rng.normal(size=D).astype(np.float32)
+        model = onnx_model(
+            nodes=[
+                onnx_node("Gather", ["table", "ids"], ["emb"],
+                          onnx_attr("axis", i=0)),
+                onnx_node("LayerNormalization", ["emb", "gamma", "beta"],
+                          ["ln"], onnx_attr("epsilon", f=1e-5)),
+                onnx_node("Gelu", ["ln"], ["gelu"]),
+            ],
+            initializers=[onnx_tensor("table", table),
+                          onnx_tensor("gamma", gamma),
+                          onnx_tensor("beta", beta)],
+            inputs=["ids"], outputs=["gelu"])
+        imported = OnnxModelImport.import_model(model)
+        ids = rng.integers(0, V, (2, T)).astype(np.int64)
+        got = np.asarray(imported.output({"ids": ids}, ["gelu"]))
+
+        emb = table[ids]
+        mu = emb.mean(-1, keepdims=True)
+        var = emb.var(-1, keepdims=True)
+        ln = (emb - mu) / np.sqrt(var + 1e-5) * gamma + beta
+        from scipy.special import erf
+
+        want = 0.5 * ln * (1 + erf(ln / np.sqrt(2)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_reduce_clip_where_split(self, rng):
+        x = rng.normal(size=(2, 6)).astype(np.float32)
+        model = onnx_model(
+            nodes=[
+                onnx_node("ReduceMean", ["x"], ["m"],
+                          onnx_attr("axes", ints=[1]), onnx_attr("keepdims", i=1)),
+                onnx_node("Clip", ["x"], ["c"],
+                          onnx_attr("min", f=-0.5), onnx_attr("max", f=0.5)),
+                onnx_node("Equal", ["x", "x"], ["e"]),
+                onnx_node("Where", ["e", "c", "m"], ["w"]),
+                onnx_node("Split", ["w"], ["s0", "s1"],
+                          onnx_attr("axis", i=1), onnx_attr("split", ints=[2, 4])),
+            ],
+            initializers=[], inputs=["x"], outputs=["s0", "s1"])
+        imported = OnnxModelImport.import_model(model)
+        s0, s1 = imported.output({"x": x}, ["s0", "s1"])
+        clipped = np.clip(x, -0.5, 0.5)
+        np.testing.assert_allclose(np.asarray(s0), clipped[:, :2], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(s1), clipped[:, 2:], rtol=1e-5)
+
+    def test_unsqueeze_pow_sqrt_jittable(self, rng):
+        import jax
+
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        model = onnx_model(
+            nodes=[
+                onnx_node("Pow", ["x", "two"], ["sq"]),
+                onnx_node("ReduceSum", ["sq"], ["ss"],
+                          onnx_attr("axes", ints=[1]), onnx_attr("keepdims", i=1)),
+                onnx_node("Sqrt", ["ss"], ["n"]),
+                onnx_node("Unsqueeze", ["n"], ["u"], onnx_attr("axes", ints=[0])),
+            ],
+            initializers=[onnx_tensor("two", np.asarray([2.0], np.float32))],
+            inputs=["x"], outputs=["u"])
+        imported = OnnxModelImport.import_model(model)
+        fn = imported.as_function(["u"])
+        got = np.asarray(jax.jit(lambda a: fn(x=a))(x))
+        want = np.sqrt((x ** 2).sum(1, keepdims=True))[None]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestOnnxOptionalInputs:
+    def test_clip_with_omitted_min(self, rng):
+        """ONNX marks omitted optional inputs with empty names; positions
+        must not shift (max arriving as xs[1] would become the LOWER bound)."""
+        x = rng.normal(size=(2, 4)).astype(np.float32) * 3
+        model = onnx_model(
+            nodes=[onnx_node("Clip", ["x", "", "hi"], ["y"])],
+            initializers=[onnx_tensor("hi", np.asarray([1.0], np.float32))],
+            inputs=["x"], outputs=["y"])
+        imported = OnnxModelImport.import_model(model)
+        got = np.asarray(imported.output({"x": x}, ["y"]))
+        np.testing.assert_allclose(got, np.minimum(x, 1.0), rtol=1e-6)
+
+    def test_split_equal_default_three_outputs(self, rng):
+        x = rng.normal(size=(2, 9)).astype(np.float32)
+        model = onnx_model(
+            nodes=[onnx_node("Split", ["x"], ["a", "b", "c"],
+                             onnx_attr("axis", i=1))],
+            initializers=[], inputs=["x"], outputs=["a", "b", "c"])
+        imported = OnnxModelImport.import_model(model)
+        a, b, c = imported.output({"x": x}, ["a", "b", "c"])
+        np.testing.assert_allclose(np.asarray(a), x[:, :3], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(c), x[:, 6:], rtol=1e-6)
+
+    def test_layernorm_multi_axis(self, rng):
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        model = onnx_model(
+            nodes=[onnx_node("LayerNormalization", ["x"], ["y"],
+                             onnx_attr("axis", i=1))],
+            initializers=[], inputs=["x"], outputs=["y"])
+        imported = OnnxModelImport.import_model(model)
+        got = np.asarray(imported.output({"x": x}, ["y"]))
+        mu = x.mean((1, 2), keepdims=True)
+        var = x.var((1, 2), keepdims=True)
+        np.testing.assert_allclose(got, (x - mu) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
