@@ -66,14 +66,25 @@ def _parse_onnx_tensor(buf: bytes) -> tuple:
 
 class OnnxAttr:
     """AttributeProto: name=1, f=2 (fixed32 float), i=3, s=4, t=5,
-    floats=7, ints=8."""
+    floats=7, ints=8, type=20.
+
+    proto3 omits zero-valued singular fields from the wire, so an explicit
+    ``axis = 0`` arrives with no ``i`` field at all — only the declared
+    ``type`` reveals it. When the type says INT/FLOAT/STRING and the value
+    field is absent, the value IS the proto3 default (0 / 0.0 / "")."""
+
+    _FLOAT, _INT, _STRING = 1, 2, 3
 
     def __init__(self, buf: bytes):
         f = parse_message(buf)
         self.name = f[1][0].decode()
-        self.f = struct.unpack("<f", f[2][0])[0] if 2 in f else None
-        self.i = _varints(f[3])[0] if 3 in f else None
-        self.s = f[4][0].decode() if 4 in f else None
+        self.type = f[20][0] if 20 in f else None
+        self.f = struct.unpack("<f", f[2][0])[0] if 2 in f else (
+            0.0 if self.type == self._FLOAT else None)
+        self.i = _varints(f[3])[0] if 3 in f else (
+            0 if self.type == self._INT else None)
+        self.s = f[4][0].decode() if 4 in f else (
+            "" if self.type == self._STRING else None)
         self.t = _parse_onnx_tensor(f[5][0])[1] if 5 in f else None
         self.ints = _varints(f.get(8, []))
 
@@ -172,8 +183,9 @@ def _gemm(node, xs):
     if tb and tb.i:
         b = b.T
     y = (alpha.f if alpha and alpha.f is not None else 1.0) * (a @ b)
-    if len(xs) > 2:
-        y = y + (beta.f if beta and beta.f is not None else 1.0) * xs[2]
+    c = _opt(xs, 2)
+    if c is not None:
+        y = y + (beta.f if beta and beta.f is not None else 1.0) * c
     return y
 
 
@@ -231,7 +243,8 @@ def _reshape(node, xs):
 @onnx_op("Concat")
 def _concat(node, xs):
     ax = node.attr("axis")
-    return jnp.concatenate(xs, axis=ax.i if ax else 1)
+    axis = ax.i if ax is not None and ax.i is not None else 1
+    return jnp.concatenate(xs, axis=axis)
 
 
 @onnx_op("Transpose")
@@ -260,8 +273,8 @@ def _const_ints(node, xs, attr_name, input_idx):
 @onnx_op("Gather")
 def _gather(node, xs):
     a = node.attr("axis")
-    return jnp.take(xs[0], jnp.asarray(xs[1]).astype(jnp.int32),
-                    axis=a.i if a else 0)
+    axis = a.i if a is not None and a.i is not None else 0
+    return jnp.take(xs[0], jnp.asarray(xs[1]).astype(jnp.int32), axis=axis)
 
 
 @onnx_op("Squeeze")
@@ -332,10 +345,8 @@ def _clip(node, xs):
     lo = node.attr("min")
     hi = node.attr("max")
     lo_t, hi_t = _opt(xs, 1), _opt(xs, 2)
-    lo_v = lo.f if lo is not None else (
-        np.asarray(lo_t).ravel()[0] if lo_t is not None else None)
-    hi_v = hi.f if hi is not None else (
-        np.asarray(hi_t).ravel()[0] if hi_t is not None else None)
+    lo_v = lo.f if lo is not None else lo_t  # tensors stay symbolic (jit)
+    hi_v = hi.f if hi is not None else hi_t
     return jnp.clip(xs[0], lo_v, hi_v)
 
 
@@ -368,24 +379,26 @@ def _layer_norm(node, xs):
     eps = node.attr("epsilon")
     eps_v = eps.f if eps is not None else 1e-5
     ax = node.attr("axis")
-    axis = ax.i if ax is not None else -1
+    axis = ax.i if ax is not None and ax.i is not None else -1
     x = xs[0]
     # ONNX normalizes over ALL trailing dims starting at `axis`
     axes = tuple(range(axis % x.ndim, x.ndim))
     mu = x.mean(axes, keepdims=True)
     var = x.var(axes, keepdims=True)
     out = (x - mu) / jnp.sqrt(var + eps_v)
-    if len(xs) > 1:
-        out = out * xs[1]
-    if len(xs) > 2:
-        out = out + xs[2]
+    scale_t = _opt(xs, 1)
+    if scale_t is not None:
+        out = out * scale_t
+    bias_t = _opt(xs, 2)
+    if bias_t is not None:
+        out = out + bias_t
     return out
 
 
 @onnx_op("Split")
 def _split(node, xs):
     ax = node.attr("axis")
-    axis = ax.i if ax is not None else 0
+    axis = ax.i if ax is not None and ax.i is not None else 0
     n = node.attr("num_outputs")
     splits = _const_ints(node, xs, "split", 1)
     if splits:
@@ -402,6 +415,9 @@ def _pad(node, xs):
     mode_s = mode.s if mode is not None else "constant"
     if mode_s not in ("constant", "reflect", "edge"):
         raise NotImplementedError(f"Pad mode {mode_s!r} is not supported")
+    if _opt(xs, 3) is not None:
+        raise NotImplementedError("Pad with an explicit axes input (opset 18) "
+                                  "is not supported")
     pads = _const_ints(node, xs, "pads", 1)
     rank = xs[0].ndim
     pairs = [(pads[i], pads[i + rank]) for i in range(rank)]

@@ -21,7 +21,8 @@ def onnx_tensor(name: str, arr: np.ndarray) -> bytes:
     return out
 
 
-def onnx_attr(name: str, *, f=None, i=None, s=None, ints=None) -> bytes:
+def onnx_attr(name: str, *, f=None, i=None, s=None, ints=None,
+              type_=None) -> bytes:
     out = _len_field(1, name.encode())
     if f is not None:
         out += _tag(2, 5) + struct.pack("<f", f)
@@ -31,6 +32,8 @@ def onnx_attr(name: str, *, f=None, i=None, s=None, ints=None) -> bytes:
         out += _len_field(4, s.encode())
     if ints is not None:
         out += b"".join(_int_field(8, v) for v in ints)
+    if type_ is not None:
+        out += _int_field(20, type_)
     return out
 
 
@@ -242,3 +245,32 @@ class TestOnnxOptionalInputs:
         var = x.var((1, 2), keepdims=True)
         np.testing.assert_allclose(got, (x - mu) / np.sqrt(var + 1e-5),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestProto3ZeroAttrs:
+    def test_explicit_axis_zero_omitted_on_wire(self, rng):
+        """proto3 drops zero-valued ints: Gather(axis=0) arrives with only
+        the attr name + type=INT. Must gather rows, not flatten."""
+        V, D = 5, 3
+        table = rng.normal(size=(V, D)).astype(np.float32)
+        model = onnx_model(
+            nodes=[onnx_node("Gather", ["t", "ids"], ["e"],
+                             onnx_attr("axis", type_=2))],  # INT, value omitted
+            initializers=[onnx_tensor("t", table)],
+            inputs=["ids"], outputs=["e"])
+        imported = OnnxModelImport.import_model(model)
+        ids = np.array([2, 0], np.int64)
+        got = np.asarray(imported.output({"ids": ids}, ["e"]))
+        np.testing.assert_allclose(got, table[[2, 0]], rtol=1e-6)
+
+    def test_gemm_conv_omitted_optional_inputs(self, rng):
+        """Empty-named optional inputs must not crash the older mappers."""
+        A = rng.normal(size=(3, 4)).astype(np.float32)
+        B = rng.normal(size=(4, 2)).astype(np.float32)
+        model = onnx_model(
+            nodes=[onnx_node("Gemm", ["a", "b", ""], ["y"])],
+            initializers=[onnx_tensor("b", B)],
+            inputs=["a"], outputs=["y"])
+        imported = OnnxModelImport.import_model(model)
+        got = np.asarray(imported.output({"a": A}, ["y"]))
+        np.testing.assert_allclose(got, A @ B, rtol=1e-5)
