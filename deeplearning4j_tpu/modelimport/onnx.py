@@ -439,8 +439,9 @@ def _conv(node, xs):
         rhs_dilation=tuple(node.ints("dilations", (1, 1))),
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=group.i if group and group.i else 1)
-    if len(xs) > 2:
-        y = y + xs[2].reshape(1, -1, 1, 1)
+    b = _opt(xs, 2)
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
     return y
 
 

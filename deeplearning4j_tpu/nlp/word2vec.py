@@ -77,16 +77,94 @@ def _cbow_neg_step(W, C, context_win, center, negatives, lr):
     return W - lr * grads[0], C - lr * grads[1], loss
 
 
+def build_huffman(freqs) -> tuple:
+    """Huffman coding over word frequencies (the reference's Huffman class in
+    deeplearning4j-nlp, used by its default hierarchical softmax).
+
+    Returns (codes [V, L] int8 0/1, points [V, L] int32 inner-node ids,
+    mask [V, L] float32) padded to the longest code length L — fixed shapes
+    so the HS step jits once.
+    """
+    import heapq
+
+    V = len(freqs)
+    if V == 1:
+        return (np.zeros((1, 1), np.int8), np.zeros((1, 1), np.int32),
+                np.ones((1, 1), np.float32))
+    heap = [(int(f), i, None, None) for i, f in enumerate(freqs)]
+    heapq.heapify(heap)
+    next_id = V
+    nodes = {}
+    while len(heap) > 1:
+        f1, id1, l1, r1 = heapq.heappop(heap)
+        f2, id2, l2, r2 = heapq.heappop(heap)
+        nodes[next_id] = (id1, id2)
+        heapq.heappush(heap, (f1 + f2, next_id, id1, id2))
+        next_id += 1
+    root = heap[0][1]
+
+    codes: list = [None] * V
+    points: list = [None] * V
+
+    def walk(node, code, path):
+        if node < V:
+            codes[node] = code
+            points[node] = path
+            return
+        left, right = nodes[node]
+        # inner-node parameter index: node - V (V-1 inner nodes total)
+        walk(left, code + [0], path + [node - V])
+        walk(right, code + [1], path + [node - V])
+
+    walk(root, [], [])
+    L = max(len(c) for c in codes)
+    code_m = np.zeros((V, L), np.int8)
+    point_m = np.zeros((V, L), np.int32)
+    mask_m = np.zeros((V, L), np.float32)
+    for i in range(V):
+        n = len(codes[i])
+        code_m[i, :n] = codes[i]
+        point_m[i, :n] = points[i]
+        mask_m[i, :n] = 1.0
+    return code_m, point_m, mask_m
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sg_hs_step(W, Theta, center, context, codes, points, mask, lr):
+    """Hierarchical-softmax skip-gram step: for a (center, context) pair the
+    loss walks the CONTEXT word's Huffman path with the center's input
+    vector — loss = -sum_l mask * log sigma((1-2*code_l) * w . theta_l).
+    Theta holds one vector per inner node ([V-1, D])."""
+
+    def loss_fn(params):
+        W_, T_ = params
+        w = W_[center]                           # [B, D]
+        th = T_[points[context]]                 # [B, L, D]
+        sign = 1.0 - 2.0 * codes[context].astype(jnp.float32)  # [B, L]
+        logits = sign * jnp.einsum("bd,bld->bl", w, th)
+        logp = jax.nn.log_sigmoid(logits) * mask[context]
+        return -logp.sum() / center.shape[0]
+
+    loss, g = jax.value_and_grad(loss_fn)((W, Theta))
+    return W - lr * g[0], Theta - lr * g[1], loss
+
+
 class Word2Vec:
-    """Builder-style Word2Vec (reference: Word2Vec.Builder()...build().fit())."""
+    """Builder-style Word2Vec (reference: Word2Vec.Builder()...build().fit()).
+
+    ``hs=True`` selects hierarchical softmax over a Huffman tree (the
+    reference's default); otherwise negative sampling with ``negative``
+    noise words."""
 
     def __init__(self, vector_size: int = 100, window: int = 5,
                  min_count: int = 1, negative: int = 5, epochs: int = 1,
                  learning_rate: float = 0.025, cbow: bool = False,
-                 subsample: float = 0.0, batch_size: int = 512, seed: int = 42):
+                 subsample: float = 0.0, batch_size: int = 512, seed: int = 42,
+                 hs: bool = False):
         self.vector_size = vector_size
         self.window = window
         self.negative = negative
+        self.hs = hs
         self.epochs = epochs
         self.lr = learning_rate
         self.cbow = cbow
@@ -134,6 +212,15 @@ class Word2Vec:
             encoded = [s[rng.random(len(s)) < keep[s]] for s in encoded]
 
         W, C = jnp.asarray(self.W), jnp.asarray(self.C)
+        if self.hs and self.cbow:
+            raise ValueError("cbow=True with hs=True is not supported; use "
+                             "negative sampling for CBOW")
+        huffman = None
+        if self.hs and not self.cbow:
+            # per-fit: the tree depends on THIS corpus's vocabulary
+            freqs = [self.vocab.counts[w_] for w_ in self.vocab.words]
+            huffman = tuple(jnp.asarray(a) for a in build_huffman(freqs))
+            C = jnp.asarray(np.zeros((max(V - 1, 1), D), np.float32))
         for _ in range(self.epochs):
             if self.cbow:
                 centers, ctxs = cbow_windows(encoded, self.window)
@@ -147,6 +234,18 @@ class Word2Vec:
                     W, C, _ = _cbow_neg_step(W, C, jnp.asarray(ctxs[s:s + B]),
                                              jnp.asarray(centers[s:s + B]),
                                              jnp.asarray(negs), lr=self.lr)
+            elif self.hs:
+                pairs = self._pairs(encoded, rng)
+                if len(pairs) == 0:
+                    continue
+                codes_m, points_m, mask_m = huffman
+                pairs = pairs[rng.permutation(len(pairs))]
+                B = min(self.batch_size, len(pairs))
+                for s in range(0, (len(pairs) // B) * B, B):
+                    batch = pairs[s:s + B]
+                    W, C, _ = _sg_hs_step(W, C, jnp.asarray(batch[:, 0]),
+                                          jnp.asarray(batch[:, 1]),
+                                          codes_m, points_m, mask_m, self.lr)
             else:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:

@@ -109,3 +109,55 @@ class TestParagraphVectors:
                               seed=2).fit(docs)
         v = pv.infer_vector("the cat sat")
         assert v.shape == (16,) and np.isfinite(v).all()
+
+
+class TestHierarchicalSoftmax:
+    def test_huffman_codes_prefix_free_and_frequency_ordered(self):
+        from deeplearning4j_tpu.nlp.word2vec import build_huffman
+
+        freqs = [50, 20, 10, 5, 5, 2]
+        codes, points, mask = build_huffman(freqs)
+        lens = mask.sum(1).astype(int)
+        # most frequent word gets the shortest code
+        assert lens[0] == lens.min()
+        assert lens[5] == lens.max()
+        # prefix-free: no code is a prefix of another
+        strs = ["".join(str(b) for b in codes[i, :lens[i]])
+                for i in range(len(freqs))]
+        for i in range(len(strs)):
+            for j in range(len(strs)):
+                if i != j:
+                    assert not strs[j].startswith(strs[i])
+        # points index inner nodes (V-1 of them)
+        assert points.max() < len(freqs) - 1
+
+    def test_hs_training_learns_cooccurrence(self):
+        from deeplearning4j_tpu.nlp import Word2Vec
+
+        corpus = ["the cat sat on the mat", "the dog sat on the rug",
+                  "cats and dogs and cats"] * 30
+        w2v = Word2Vec(vector_size=16, window=2, min_count=1, epochs=8,
+                       learning_rate=0.05, hs=True, seed=1)
+        w2v.fit(corpus)
+        sims = w2v.words_nearest("cat", 3)
+        assert len(sims) == 3
+        v = w2v.get_word_vector("sat")
+        assert v is not None and np.isfinite(v).all() and np.abs(v).sum() > 0
+
+
+def test_cbow_hs_rejected():
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    with pytest.raises(ValueError, match="cbow"):
+        Word2Vec(cbow=True, hs=True).fit(["a b c a b c"])
+
+
+def test_refit_rebuilds_huffman():
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    w2v = Word2Vec(vector_size=8, window=2, epochs=2, hs=True, seed=0)
+    w2v.fit(["a b c a b", "b c a"] * 10)
+    # second fit with a LARGER vocab must not reuse the old tree/Theta
+    w2v.fit(["p q r s t u v w x y z p q r" ] * 10)
+    v = w2v.get_word_vector("q")
+    assert v is not None and np.isfinite(v).all()

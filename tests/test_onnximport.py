@@ -274,3 +274,17 @@ class TestProto3ZeroAttrs:
         imported = OnnxModelImport.import_model(model)
         got = np.asarray(imported.output({"a": A}, ["y"]))
         np.testing.assert_allclose(got, A @ B, rtol=1e-5)
+
+
+def test_conv_omitted_bias(rng):
+    K = rng.normal(size=(3, 2, 3, 3)).astype(np.float32)
+    model = onnx_model(
+        nodes=[onnx_node("Conv", ["x", "K", ""], ["y"],
+                         onnx_attr("strides", ints=[1, 1]),
+                         onnx_attr("auto_pad", s="SAME_UPPER"),
+                         onnx_attr("kernel_shape", ints=[3, 3]))],
+        initializers=[onnx_tensor("K", K)], inputs=["x"], outputs=["y"])
+    g = OnnxModelImport.import_model(model)
+    x = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+    out = np.asarray(g.output({"x": x}, ["y"]))
+    assert out.shape == (1, 3, 6, 6) and np.isfinite(out).all()
