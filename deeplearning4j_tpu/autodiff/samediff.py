@@ -855,6 +855,31 @@ class SameDiff:
                          name=f"{name}_out{i}")
                 for i in range(len(inputs))]
 
+    def scan(self, body_graph: "SameDiff", init: SDVariable, xs: SDVariable,
+             consts: Sequence[SDVariable] = (), name: Optional[str] = None):
+        """lax.scan over the leading axis of ``xs``.
+
+        body_graph: placeholders ``carry`` and ``x`` (plus ``const0..N`` when
+        ``consts`` are given) -> ops named ``carry_out`` (next carry) and
+        optionally an op named ``y`` (per-step output; defaults to the
+        carry). Returns (final_carry, stacked_ys) — the compiler-friendly
+        sequence loop the reference writes as an unrolled time loop in
+        SameDiff RNN ops.
+
+        Trainable weights belong in the OUTER graph, passed via ``consts``
+        so they flow through the graph and receive gradients; var()s defined
+        inside the body are baked-in constants (as in cond/while bodies).
+        """
+        name = name or self._fresh("scan")
+        node = _Node(name, "control", op="scan",
+                     inputs=(init.name, xs.name) + tuple(c.name for c in consts),
+                     subgraphs={"body": body_graph})
+        var = self._add(node)
+        final = self._op("tuple_get", var, attrs={"index": 0},
+                         name=f"{name}_carry")
+        ys = self._op("tuple_get", var, attrs={"index": 1}, name=f"{name}_ys")
+        return final, ys
+
     @staticmethod
     def _subgraph_fn(sub: "SameDiff", outputs: Optional[list] = None):
         outputs = outputs or ["out"]
@@ -863,6 +888,17 @@ class SameDiff:
 
         def call(*args):
             ph = {f"arg{i}": a for i, a in enumerate(args)}
+            outs = fn(svars, ph)
+            return outs[0] if len(outs) == 1 else tuple(outs)
+        return call
+
+    @staticmethod
+    def _subgraph_fn_named(sub: "SameDiff", arg_names: list, outputs: list):
+        fn = sub._build_fn(outputs)
+        svars = sub.variables()
+
+        def call(*args):
+            ph = dict(zip(arg_names, args))
             outs = fn(svars, ph)
             return outs[0] if len(outs) == 1 else tuple(outs)
         return call
@@ -909,6 +945,22 @@ class SameDiff:
                     return r if isinstance(r, tuple) else (r,)
                 final = jax.lax.while_loop(cond_w, body_w, tuple(args))
                 return final[0] if len(final) == 1 else final
+            return run
+        if node.op == "scan":
+            body = node.subgraphs["body"]
+            has_y = "y" in body._nodes and body._nodes["y"].kind == "op"
+            outs = ["carry_out", "y"] if has_y else ["carry_out"]
+            n_consts = len(node.inputs) - 2
+            arg_names = ["carry", "x"] + [f"const{i}" for i in range(n_consts)]
+            bfn = self._subgraph_fn_named(body, arg_names, outs)
+
+            def run(init, xs, *cs):
+                def step(carry, x_t):
+                    r = bfn(carry, x_t, *cs)
+                    if isinstance(r, tuple):
+                        return r[0], r[1]
+                    return r, r
+                return jax.lax.scan(step, init, xs)
             return run
         raise ValueError(f"unknown control op {node.op}")
 

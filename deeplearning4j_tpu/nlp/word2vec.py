@@ -129,7 +129,7 @@ def build_huffman(freqs) -> tuple:
     return code_m, point_m, mask_m
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
 def _sg_hs_step(W, Theta, center, context, codes, points, mask, lr):
     """Hierarchical-softmax skip-gram step: for a (center, context) pair the
     loss walks the CONTEXT word's Huffman path with the center's input
@@ -143,7 +143,9 @@ def _sg_hs_step(W, Theta, center, context, codes, points, mask, lr):
         sign = 1.0 - 2.0 * codes[context].astype(jnp.float32)  # [B, L]
         logits = sign * jnp.einsum("bd,bld->bl", w, th)
         logp = jax.nn.log_sigmoid(logits) * mask[context]
-        return -logp.sum() / center.shape[0]
+        # summed like the negative-sampling steps: per-pair update strength
+        # must not shrink with batch size at a given lr
+        return -logp.sum()
 
     loss, g = jax.value_and_grad(loss_fn)((W, Theta))
     return W - lr * g[0], Theta - lr * g[1], loss
@@ -245,7 +247,7 @@ class Word2Vec:
                     batch = pairs[s:s + B]
                     W, C, _ = _sg_hs_step(W, C, jnp.asarray(batch[:, 0]),
                                           jnp.asarray(batch[:, 1]),
-                                          codes_m, points_m, mask_m, self.lr)
+                                          codes_m, points_m, mask_m, lr=self.lr)
             else:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:

@@ -137,12 +137,13 @@ class TestHierarchicalSoftmax:
         corpus = ["the cat sat on the mat", "the dog sat on the rug",
                   "cats and dogs and cats"] * 30
         w2v = Word2Vec(vector_size=16, window=2, min_count=1, epochs=8,
-                       learning_rate=0.05, hs=True, seed=1)
+                       learning_rate=0.01, hs=True, seed=1)
         w2v.fit(corpus)
-        sims = w2v.words_nearest("cat", 3)
-        assert len(sims) == 3
         v = w2v.get_word_vector("sat")
         assert v is not None and np.isfinite(v).all() and np.abs(v).sum() > 0
+        # learned co-occurrence: "sat" appears next to "on" in every
+        # sentence, never next to "cats" — similarity must reflect that
+        assert w2v.similarity("sat", "on") > w2v.similarity("sat", "cats")
 
 
 def test_cbow_hs_rejected():

@@ -295,3 +295,81 @@ def test_parametric_activations():
                                np.clip(x, 0, 6), rtol=1e-6)
     with pytest.raises(ValueError):
         get_activation("softmax:2.0")
+
+
+def test_scan_cumulative_rnn(tmp_path):
+    """scan: simple RNN-style recurrence h' = tanh(h*a + x), with save/load
+    round trip (the body serializes as a sub-graph like cond/while)."""
+    bg = SameDiff.create()
+    h = bg.placeholder("carry")
+    x = bg.placeholder("x")
+    a = bg.var("a", np.float32(0.5))
+    bg.tanh(bg.add(bg.mul(h, a), x), name="carry_out")
+    bg.identity(bg.getVariable("carry_out"), name="y")
+
+    sd = SameDiff.create()
+    h0 = sd.placeholder("h0")
+    xs = sd.placeholder("xs")
+    final, ys = sd.scan(bg, h0, xs, name="rnn")
+
+    xv = np.array([0.1, -0.2, 0.3, 0.4], np.float32)
+    got_final = float(final.eval(h0=np.float32(0.0), xs=xv))
+    got_ys = np.asarray(ys.eval(h0=np.float32(0.0), xs=xv))
+
+    hh = 0.0
+    ref = []
+    for t in range(4):
+        hh = np.tanh(hh * 0.5 + xv[t])
+        ref.append(hh)
+    np.testing.assert_allclose(got_ys, np.asarray(ref, np.float32), rtol=1e-5)
+    assert abs(got_final - ref[-1]) < 1e-5
+
+    p = str(tmp_path / "scan.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    np.testing.assert_allclose(
+        np.asarray(sd2.output("rnn_ys", h0=np.float32(0.0), xs=xv)),
+        np.asarray(ref, np.float32), rtol=1e-5)
+
+
+def test_scan_gradient():
+    bg = SameDiff.create()
+    h = bg.placeholder("carry")
+    x = bg.placeholder("x")
+    bg.add(h, x, name="carry_out")
+
+    sd = SameDiff.create()
+    h0 = sd.placeholder("h0")
+    xs = sd.placeholder("xs")
+    w = sd.var("w", np.float32(2.0))
+    final, _ = sd.scan(bg, sd.mul(h0, w), xs)
+    sd.set_loss(sd.square(final))
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    g = sd.grad(sd.square(final), h0=np.float32(1.0), xs=xv)["w"]
+    # final = w*1 + 6; d(final^2)/dw = 2*(w+6)*1 = 16
+    assert abs(float(g) - 16.0) < 1e-4
+
+
+def test_scan_trainable_weight_via_consts():
+    """Trainable recurrence: the weight lives in the OUTER graph and enters
+    the body via consts, so grad()/fit() see it."""
+    bg = SameDiff.create()
+    h = bg.placeholder("carry")
+    x = bg.placeholder("x")
+    w = bg.placeholder("const0")
+    bg.add(bg.mul(h, w), x, name="carry_out")
+
+    sd = SameDiff.create()
+    h0 = sd.placeholder("h0")
+    xs = sd.placeholder("xs")
+    wv = sd.var("w", np.float32(0.5))
+    final, _ = sd.scan(bg, h0, xs, consts=[wv])
+    sd.set_loss(sd.square(final))
+    xv = np.array([1.0, 1.0], np.float32)
+    # final(w) = (h0*w + 1)*w + 1 = h0 w^2 + w + 1; h0=1 -> w^2+w+1
+    # d(final^2)/dw = 2(w^2+w+1)(2w+1); at w=0.5: 2*1.75*2 = 7
+    g = sd.grad(sd.square(final), h0=np.float32(1.0), xs=xv)["w"]
+    assert abs(float(g) - 7.0) < 1e-4
+    # and fit() actually moves it
+    loss = sd.fit(updater=Adam(lr=0.05), steps=50, h0=np.float32(1.0), xs=xv)
+    assert loss < 1.75 ** 2
