@@ -881,24 +881,17 @@ class SameDiff:
         return final, ys
 
     @staticmethod
-    def _subgraph_fn(sub: "SameDiff", outputs: Optional[list] = None):
+    def _subgraph_fn(sub: "SameDiff", outputs: Optional[list] = None,
+                     arg_names: Optional[list] = None):
+        """Callable over a sub-graph: args bind to ``arg_names`` placeholders
+        (default arg0..argN), outputs default to the single op 'out'."""
         outputs = outputs or ["out"]
         fn = sub._build_fn(outputs)
         svars = sub.variables()
 
         def call(*args):
-            ph = {f"arg{i}": a for i, a in enumerate(args)}
-            outs = fn(svars, ph)
-            return outs[0] if len(outs) == 1 else tuple(outs)
-        return call
-
-    @staticmethod
-    def _subgraph_fn_named(sub: "SameDiff", arg_names: list, outputs: list):
-        fn = sub._build_fn(outputs)
-        svars = sub.variables()
-
-        def call(*args):
-            ph = dict(zip(arg_names, args))
+            names = arg_names or [f"arg{i}" for i in range(len(args))]
+            ph = dict(zip(names, args))
             outs = fn(svars, ph)
             return outs[0] if len(outs) == 1 else tuple(outs)
         return call
@@ -952,7 +945,7 @@ class SameDiff:
             outs = ["carry_out", "y"] if has_y else ["carry_out"]
             n_consts = len(node.inputs) - 2
             arg_names = ["carry", "x"] + [f"const{i}" for i in range(n_consts)]
-            bfn = self._subgraph_fn_named(body, arg_names, outs)
+            bfn = self._subgraph_fn(body, outs, arg_names)
 
             def run(init, xs, *cs):
                 def step(carry, x_t):

@@ -129,12 +129,20 @@ def build_huffman(freqs) -> tuple:
     return code_m, point_m, mask_m
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
-def _sg_hs_step(W, Theta, center, context, codes, points, mask, lr):
-    """Hierarchical-softmax skip-gram step: for a (center, context) pair the
-    loss walks the CONTEXT word's Huffman path with the center's input
-    vector — loss = -sum_l mask * log sigma((1-2*code_l) * w . theta_l).
-    Theta holds one vector per inner node ([V-1, D])."""
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnames=("lr",))
+def _sg_hs_step(W, Theta, accW, accT, center, context, codes, points, mask, lr):
+    """Hierarchical-softmax skip-gram step with Adagrad scaling.
+
+    For a (center, context) pair the loss walks the CONTEXT word's Huffman
+    path with the center's input vector:
+    loss = -sum_l mask * log sigma((1-2*code_l) * w . theta_l);
+    Theta holds one vector per inner node ([V-1, D]).
+
+    The summed batch loss concentrates B gradient contributions on the few
+    inner nodes near the Huffman root (plain SGD diverges there at any lr
+    that still moves the leaves), so the update is Adagrad-normalized per
+    parameter — the classic fix for embedding-frequency imbalance; accW/accT
+    carry the squared-gradient accumulators across batches."""
 
     def loss_fn(params):
         W_, T_ = params
@@ -143,12 +151,14 @@ def _sg_hs_step(W, Theta, center, context, codes, points, mask, lr):
         sign = 1.0 - 2.0 * codes[context].astype(jnp.float32)  # [B, L]
         logits = sign * jnp.einsum("bd,bld->bl", w, th)
         logp = jax.nn.log_sigmoid(logits) * mask[context]
-        # summed like the negative-sampling steps: per-pair update strength
-        # must not shrink with batch size at a given lr
         return -logp.sum()
 
     loss, g = jax.value_and_grad(loss_fn)((W, Theta))
-    return W - lr * g[0], Theta - lr * g[1], loss
+    accW = accW + g[0] * g[0]
+    accT = accT + g[1] * g[1]
+    W = W - lr * g[0] / jnp.sqrt(accW + 1e-8)
+    Theta = Theta - lr * g[1] / jnp.sqrt(accT + 1e-8)
+    return W, Theta, accW, accT, loss
 
 
 class Word2Vec:
@@ -223,6 +233,8 @@ class Word2Vec:
             freqs = [self.vocab.counts[w_] for w_ in self.vocab.words]
             huffman = tuple(jnp.asarray(a) for a in build_huffman(freqs))
             C = jnp.asarray(np.zeros((max(V - 1, 1), D), np.float32))
+            accW = jnp.zeros_like(W)
+            accT = jnp.zeros_like(C)
         for _ in range(self.epochs):
             if self.cbow:
                 centers, ctxs = cbow_windows(encoded, self.window)
@@ -245,9 +257,10 @@ class Word2Vec:
                 B = min(self.batch_size, len(pairs))
                 for s in range(0, (len(pairs) // B) * B, B):
                     batch = pairs[s:s + B]
-                    W, C, _ = _sg_hs_step(W, C, jnp.asarray(batch[:, 0]),
-                                          jnp.asarray(batch[:, 1]),
-                                          codes_m, points_m, mask_m, lr=self.lr)
+                    W, C, accW, accT, _ = _sg_hs_step(
+                        W, C, accW, accT, jnp.asarray(batch[:, 0]),
+                        jnp.asarray(batch[:, 1]),
+                        codes_m, points_m, mask_m, lr=self.lr)
             else:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:

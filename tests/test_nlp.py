@@ -136,8 +136,9 @@ class TestHierarchicalSoftmax:
 
         corpus = ["the cat sat on the mat", "the dog sat on the rug",
                   "cats and dogs and cats"] * 30
+        # library DEFAULT learning rate must both learn and stay bounded
         w2v = Word2Vec(vector_size=16, window=2, min_count=1, epochs=8,
-                       learning_rate=0.01, hs=True, seed=1)
+                       learning_rate=0.025, hs=True, seed=1)
         w2v.fit(corpus)
         v = w2v.get_word_vector("sat")
         assert v is not None and np.isfinite(v).all() and np.abs(v).sum() > 0
@@ -162,3 +163,12 @@ def test_refit_rebuilds_huffman():
     w2v.fit(["p q r s t u v w x y z p q r" ] * 10)
     v = w2v.get_word_vector("q")
     assert v is not None and np.isfinite(v).all()
+
+
+def test_hs_default_lr_stays_bounded():
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    corpus = ["the cat sat on the mat", "the dog sat on the rug"] * 40
+    w2v = Word2Vec(vector_size=16, window=2, epochs=8, hs=True, seed=3).fit(corpus)
+    norms = np.linalg.norm(w2v.W, axis=1)
+    assert np.isfinite(norms).all() and norms.max() < 10.0, norms.max()
