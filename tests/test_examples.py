@@ -4,6 +4,8 @@ same way)."""
 import sys
 from pathlib import Path
 
+import numpy as np
+
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
 
 
@@ -43,3 +45,11 @@ def test_samediff_training(tmp_path):
 
     loss = samediff_training.main(steps=200, path=str(tmp_path / "m.sdz"))
     assert loss < 0.05
+
+
+def test_long_context():
+    import long_context
+
+    shape, gnorm = long_context.main(T=256, d_model=16, n_heads=4)
+    assert shape == (1, 256, 16)
+    assert np.isfinite(gnorm) and gnorm > 0
