@@ -148,6 +148,78 @@ class ComputationGraph:
         outs = fn(self.params, self.state, inputs)
         return outs[0] if len(outs) == 1 else outs
 
+    # --------------------------------------------------------- rnnTimeStep
+    def _rnn_vertices(self):
+        return [name for name, v in self.conf.vertices.items()
+                if isinstance(v, LayerVertex)
+                and hasattr(v.layer, "apply_with_carry")]
+
+    def _init_carries(self, batch: int):
+        return {name: self.conf.vertices[name].layer.initial_carry(batch)
+                for name in self._rnn_vertices()}
+
+    def _forward_carries(self, params, state, inputs, carries):
+        """Topological forward threading explicit RNN carries (the
+        ComputationGraph.rnnTimeStep walk)."""
+        acts = dict(inputs)
+        new_carries = {}
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            ins = [acts[d] for d in self.conf.vertex_inputs.get(name, [])]
+            if name in self.conf.preprocessors:
+                ins = [self.conf.preprocessors[name](ins[0])]
+            p = params.get(name, {})
+            if name in carries:
+                out, c = v.layer.apply_with_carry(p, ins[0], carries[name])
+                acts[name] = out
+                new_carries[name] = c
+            else:
+                out, _ = v.apply(p, state.get(name, {}), ins, train=False)
+                acts[name] = out
+        return [acts[n] for n in self.conf.network_outputs], new_carries
+
+    def rnn_time_step(self, *xs):
+        """Streaming inference with persisted RNN state
+        (ComputationGraph.rnnTimeStep). Inputs [B, T, F] or [B, F] (single
+        step); state persists across calls until rnn_clear_previous_state()."""
+        inputs = self._as_input_dict(xs[0] if len(xs) == 1 else list(xs))
+        single = all(v.ndim == 2 for v in inputs.values())
+        if single:
+            inputs = {k: v[:, None, :] for k, v in inputs.items()}
+        batch = next(iter(inputs.values())).shape[0]
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is not None and any(
+                jax.tree_util.tree_leaves(c)[0].shape[0] != batch
+                for c in carries.values()):
+            raise ValueError(
+                f"batch size changed between rnn_time_step calls ({batch} vs "
+                f"stored state); call rnn_clear_previous_state() first")
+        if carries is None:
+            carries = self._init_carries(batch)
+        fn = self._jit_cache.get("rnn_time_step")
+        if fn is None:
+            @jax.jit
+            def fn(params, state, inputs, carries):
+                cp = _tree_cast(params, self._policy.compute_dtype)
+                outs, new_carries = self._forward_carries(cp, state, inputs,
+                                                          carries)
+                outs = [o.astype(self._policy.output_dtype) for o in outs]
+                return outs, new_carries
+
+            self._jit_cache["rnn_time_step"] = fn
+        outs, new_carries = fn(self.params, self.state, inputs, carries)
+        # _forward_carries visits every rnn vertex, so new_carries is complete
+        self._rnn_carries = new_carries
+        if single:
+            # a LastTimeStep/feed-forward path may have collapsed the time
+            # axis already; only squeeze genuinely 3D outputs
+            outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        """ComputationGraph.rnnClearPreviousState analog."""
+        self._rnn_carries = None
+
     # ------------------------------------------------------------------- fit
     def _loss(self, params, state, inputs, labels: dict, rng, masks):
         acts, new_state, preouts, out_feats = self._forward(

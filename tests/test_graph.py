@@ -5,6 +5,7 @@ Reference analog: deeplearning4j-core ComputationGraph tests
 """
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn import (
     ComputationGraph, InputType, NeuralNetConfiguration,
@@ -87,3 +88,69 @@ class TestComputationGraph:
         loaded = ComputationGraph.load(p)
         np.testing.assert_allclose(np.asarray(model.output(x)),
                                    np.asarray(loaded.output(x)), rtol=1e-6)
+
+
+class TestGraphRnnTimeStep:
+    def test_streaming_matches_full_sequence(self, rng):
+        """ComputationGraph.rnnTimeStep analog: feeding T steps one at a time
+        must reproduce the full-sequence forward (carry threads the DAG)."""
+        from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(lr=1e-3))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.recurrent(5, 6)})
+                .add_layer("lstm", LSTMLayer(n_out=7), "in")
+                .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                                 loss="mcxent"), "lstm")
+                .set_outputs("out").build())
+        model = ComputationGraph(conf).init()
+        x = rng.normal(size=(2, 6, 5)).astype(np.float32)
+
+        full = np.asarray(model.output(x))
+        model.rnn_clear_previous_state()
+        stepped = [np.asarray(model.rnn_time_step(x[:, t])) for t in range(6)]
+        np.testing.assert_allclose(np.stack(stepped, axis=1), full,
+                                   rtol=2e-4, atol=2e-5)
+
+        # clearing state restarts the stream
+        model.rnn_clear_previous_state()
+        again = np.asarray(model.rnn_time_step(x[:, 0]))
+        np.testing.assert_allclose(again, stepped[0], rtol=1e-5)
+
+    def test_feedforward_output_not_squeezed(self, rng):
+        """A LastTimeStep path collapses the time axis; single-step streaming
+        must not slice the class dimension."""
+        from deeplearning4j_tpu.nn.layers import (
+            LastTimeStepLayer, LSTMLayer, OutputLayer,
+        )
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(lr=1e-3))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.recurrent(4, 5)})
+                .add_layer("l", LastTimeStepLayer(underlying=LSTMLayer(n_out=6)),
+                           "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss="mcxent"), "l")
+                .set_outputs("out").build())
+        model = ComputationGraph(conf).init()
+        out = np.asarray(model.rnn_time_step(
+            rng.normal(size=(2, 4)).astype(np.float32)))
+        assert out.shape == (2, 3), out.shape
+
+    def test_batch_change_raises(self, rng):
+        from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(lr=1e-3))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.recurrent(4, 5)})
+                .add_layer("l", LSTMLayer(n_out=6), "in")
+                .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                                 loss="mcxent"), "l")
+                .set_outputs("out").build())
+        model = ComputationGraph(conf).init()
+        model.rnn_time_step(rng.normal(size=(4, 4)).astype(np.float32))
+        with pytest.raises(ValueError, match="batch size changed"):
+            model.rnn_time_step(rng.normal(size=(2, 4)).astype(np.float32))
