@@ -22,7 +22,9 @@ from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.graph import LayerVertex
-from deeplearning4j_tpu.nn.multilayer import _tree_cast, _unpack, global_norm_clip
+from deeplearning4j_tpu.nn.multilayer import (
+    _check_carry_batch, _tree_cast, _unpack, global_norm_clip,
+)
 from deeplearning4j_tpu.optimize.updaters import NoOp, get_updater
 
 
@@ -188,13 +190,9 @@ class ComputationGraph:
             inputs = {k: v[:, None, :] for k, v in inputs.items()}
         batch = next(iter(inputs.values())).shape[0]
         carries = getattr(self, "_rnn_carries", None)
-        if carries is not None and any(
-                jax.tree_util.tree_leaves(c)[0].shape[0] != batch
-                for c in carries.values()):
-            raise ValueError(
-                f"batch size changed between rnn_time_step calls ({batch} vs "
-                f"stored state); call rnn_clear_previous_state() first")
-        if carries is None:
+        if carries is not None:
+            _check_carry_batch(carries, batch)
+        else:
             carries = self._init_carries(batch)
         fn = self._jit_cache.get("rnn_time_step")
         if fn is None:

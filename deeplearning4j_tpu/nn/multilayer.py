@@ -37,6 +37,18 @@ def _tree_cast(tree, dtype):
     )
 
 
+def _check_carry_batch(carries, batch: int):
+    """Stored rnn_time_step state must match the incoming batch; raise a
+    clear error instead of an opaque XLA shape failure inside jit."""
+    for c in carries.values():
+        stored = jax.tree_util.tree_leaves(c)[0].shape[0]
+        if stored != batch:
+            raise ValueError(
+                f"batch size changed between rnn_time_step calls "
+                f"({batch} vs stored {stored}); call "
+                f"rnn_clear_previous_state() first")
+
+
 def global_norm_clip(grads, max_norm):
     """DL4J GradientNormalization.ClipL2PerParamType analog (global L2 form)."""
     leaves = jax.tree_util.tree_leaves(grads)
@@ -310,7 +322,9 @@ class MultiLayerNetwork:
         if single:
             x = x[:, None, :]
         carries = getattr(self, "_rnn_carries", None)
-        if carries is None:
+        if carries is not None:
+            _check_carry_batch(carries, x.shape[0])
+        else:
             carries = self._init_carries(x.shape[0])
         fn = self._jit_cache.get("rnn_time_step")
         if fn is None:
@@ -334,7 +348,8 @@ class MultiLayerNetwork:
         merged = dict(carries)
         merged.update(new_carries)
         self._rnn_carries = merged
-        return out[:, 0] if single else out
+        # a LastTimeStep tail collapses the time axis; only squeeze 3D output
+        return out[:, 0] if single and out.ndim == 3 else out
 
     def rnn_clear_previous_state(self):
         """MultiLayerNetwork.rnnClearPreviousState analog."""

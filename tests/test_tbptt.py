@@ -90,3 +90,15 @@ class TestRnnTimeStep:
         model.rnn_clear_previous_state()
         again = np.asarray(model.rnn_time_step(x))
         np.testing.assert_allclose(first, again, rtol=1e-6)
+
+
+def test_rnn_time_step_batch_change_raises(rng):
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(lr=1e-3))
+            .list()
+            .layer(LSTMLayer(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 5)).build())
+    model = MultiLayerNetwork(conf).init()
+    model.rnn_time_step(rng.normal(size=(4, 4)).astype(np.float32))
+    with pytest.raises(ValueError, match="batch size changed"):
+        model.rnn_time_step(rng.normal(size=(2, 4)).astype(np.float32))
