@@ -8,6 +8,16 @@ query block and rotates K/V blocks around the ICI ring with ppermute while
 accumulating attention online (flash-attention-style running max/denominator),
 so peak memory is O(T/n) and the T^2 work is evenly spread.
 
+Two local cores, selected per shape:
+- the Pallas flash kernel path (``_ring_flash``): each ring step runs the
+  blocked flash forward on its current K/V block and merges (o, lse) pairs
+  online; its custom_vjp re-rotates K/V around the ring while dk/dv partial
+  gradients travel WITH their blocks, so backward memory is O(T/n * D) per
+  device too — long-context *training* stays sub-quadratic end to end.
+- a plain-XLA einsum path for small/unaligned shapes (materializes the local
+  [Tq, Tk] tile per step; fine at toy scale, and exercised by the same
+  parity tests).
+
 Also provides Ulysses-style head-scatter attention (all_to_all swapping the
 shard axis from sequence to heads), the bandwidth-cheaper alternative when
 n_heads >= n_devices.
@@ -65,20 +75,152 @@ def _ring_attention_local(q, k, v, *, axis, causal, scale):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+# --------------------------------------------------------------------------
+# flash-kernel ring core (sub-quadratic fwd AND bwd)
+# --------------------------------------------------------------------------
+
+
+def _rotate(x, axis, axis_size):
+    return lax.ppermute(x, axis, [(j, (j + 1) % axis_size) for j in range(axis_size)])
+
+
+def _merge_lse(o, lse, o_i, lse_i):
+    """Combine two softmax partial results normalized with their own lse."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
+    w_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - lse_new), 0.0)
+    return o * w_old + o_i.astype(jnp.float32) * w_new, lse_new
+
+
+def _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k):
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_block_fwd
+
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    B, H, Tq, D = q.shape
+    o = jnp.zeros((B, H, Tq, D), jnp.float32)
+    lse = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
+    o, lse = _pvary(o, (axis,)), _pvary(lse, (axis,))
+    k_cur, v_cur = k, v
+    blk = functools.partial(flash_block_fwd, scale=scale,
+                            block_q=block_q, block_k=block_k, vma=(axis,))
+    for i in range(n):
+        if i == 0:
+            # the diagonal block: start-aligned causal mask is exact here
+            o_i, lse_i = blk(q, k_cur, v_cur, causal=causal)
+        elif causal:
+            src = (my - i) % n  # which global K/V block we currently hold
+            o_i, lse_i = lax.cond(
+                src < my,
+                lambda kv: blk(q, kv[0], kv[1], causal=False),
+                lambda kv: (jnp.zeros((B, H, Tq, D), q.dtype),
+                            jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)),
+                (k_cur, v_cur))
+        else:
+            o_i, lse_i = blk(q, k_cur, v_cur, causal=False)
+        o, lse = _merge_lse(o, lse, o_i, lse_i)
+        if i < n - 1:
+            k_cur = _rotate(k_cur, axis, n)
+            v_cur = _rotate(v_cur, axis, n)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k):
+    return _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k)[0]
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis, causal, scale, block_q, block_k):
+    o, lse = _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_vjp_bwd(axis, causal, scale, block_q, block_k, res, do):
+    """True ring backward: K/V re-rotate while each block's dk/dv partial
+    travels WITH it; after n steps every carry is home with contributions
+    from every device. Per-device memory stays O(Tq/n * D)."""
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_block_bwd
+
+    q, k, v, o, lse = res
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+        axis=-1, keepdims=True)
+    dq = _pvary(jnp.zeros(q.shape, jnp.float32), (axis,))
+    dk_carry = _pvary(jnp.zeros(k.shape, jnp.float32), (axis,))
+    dv_carry = _pvary(jnp.zeros(v.shape, jnp.float32), (axis,))
+    k_cur, v_cur = k, v
+    # bwd kernels want large tiles (see ops/pallas/flash_attention._flash_bwd)
+    blk = functools.partial(flash_block_bwd, scale=scale,
+                            block_q=max(block_q, 1024), block_k=max(block_k, 1024),
+                            vma=(axis,))
+    for i in range(n):
+        if i == 0:
+            dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta, causal=causal)
+        elif causal:
+            src = (my - i) % n
+            dq_i, dk_i, dv_i = lax.cond(
+                src < my,
+                lambda kv: blk(q, kv[0], kv[1], do, lse, delta, causal=False),
+                lambda kv: (jnp.zeros(q.shape, jnp.float32),
+                            jnp.zeros(k.shape, jnp.float32),
+                            jnp.zeros(v.shape, jnp.float32)),
+                (k_cur, v_cur))
+        else:
+            dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta, causal=False)
+        dq = dq + dq_i
+        dk_carry = dk_carry + dk_i
+        dv_carry = dv_carry + dv_i
+        # the carries rotate every step INCLUDING the last — that final hop
+        # lands each block's accumulated gradient back on its home device;
+        # k/v themselves are dead after the last compute, so skip their hop
+        if i < n - 1:
+            k_cur = _rotate(k_cur, axis, n)
+            v_cur = _rotate(v_cur, axis, n)
+        dk_carry = _rotate(dk_carry, axis, n)
+        dv_carry = _rotate(dv_carry, axis, n)
+    return dq.astype(q.dtype), dk_carry.astype(k.dtype), dv_carry.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def _ring_flash_local(q, k, v, *, axis, causal, scale,
+                      block_q=512, block_k=1024):
+    return _ring_flash(q, k, v, axis, causal, scale,
+                       min(block_q, q.shape[2]), min(block_k, k.shape[2]))
+
+
+def _flash_core_ok(head_dim: int, t_local: int) -> bool:
+    """Mosaic wants lane-aligned head_dim; sublane-aligned local seq."""
+    return head_dim % 128 == 0 and t_local % 8 == 0 and t_local >= 8
+
+
 def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
-                   scale: float | None = None):
+                   scale: float | None = None, impl: str | None = None):
     """Ring attention over a mesh axis.
 
     q/k/v: [B, H, T, D] with T sharded over ``axis`` (logically; pass the
     full array — shard_map splits it). Returns [B, H, T, D] sharded the same.
+
+    impl: None (auto: flash kernel core when shapes are TPU-aligned),
+    "flash", or "einsum".
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    size = mesh.shape[axis]
+    if impl is None:
+        impl = "flash" if _flash_core_ok(q.shape[-1], q.shape[2] // size) else "einsum"
+    local = _ring_flash_local if impl == "flash" else _ring_attention_local
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis=axis, causal=causal, scale=scale),
+        functools.partial(local, axis=axis, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),
+        # pallas_call in interpret mode can't satisfy the VMA checker yet
+        # (jax hlo_interpreter dynamic_slice limitation); the einsum path
+        # keeps full checking
+        check_vma=impl != "flash",
     )
     return fn(q, k, v)
 
@@ -147,6 +289,11 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         _ulysses_causal_guard(n_heads, mesh, axis)
     elif impl != "ring":
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+    # decided here (not in the traced body) so check_vma below can match:
+    # the Pallas ring core needs the VMA checker off in interpret mode
+    _dh = x.shape[-1] // n_heads
+    _tl = x.shape[1] // mesh.shape[axis]
+    ring_flash = impl == "ring" and _flash_core_ok(_dh, _tl)
 
     def _ln(h, g, b):
         m = h.mean(-1, keepdims=True)
@@ -167,7 +314,12 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         q = heads(p["Wq"], p["bq"])
         k = heads(p["Wk"], p["bk"])
         v = heads(p["Wv"], p["bv"])
-        local = _ring_attention_local if impl == "ring" else _ulysses_local
+        if impl == "ulysses":
+            local = _ulysses_local
+        elif ring_flash:
+            local = _ring_flash_local
+        else:
+            local = _ring_attention_local
         a = local(q, k, v, axis=axis, causal=causal, scale=scale)
         a = a.transpose(0, 2, 1, 3).reshape(B, Tl, D) @ p["Wo"] + p["bo"]
         xl = xl + a
@@ -180,5 +332,6 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         block, mesh=mesh,
         in_specs=(P(), P(None, axis, None)),
         out_specs=P(None, axis, None),
+        check_vma=not ring_flash,
     )
     return fn(params, x)

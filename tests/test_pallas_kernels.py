@@ -217,3 +217,62 @@ class TestLayerPathSelection:
         mask = jnp.asarray(np.array([[1] * 5 + [0] * 3, [1] * 8], np.float32))
         out, _ = layer.apply(params, state, x, mask=mask)
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestFlashAttentionBackward:
+    """The flash backward kernels (dq, dk/dv) vs XLA's autodiff through the
+    plain lowering — the cuDNN-parity pattern for gradients. Exercises causal
+    block skipping, ragged tail blocks, and the saved-logsumexp recompute."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(2, 2, 256, 128), (1, 2, 200, 128)])
+    def test_grads_match_xla(self, rng, causal, shape):
+        import jax
+
+        B, H, T, D = shape
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        do = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+
+        _, vjp_f = jax.vjp(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=128), q, k, v)
+        _, vjp_r = jax.vjp(lambda q, k, v: dot_product_attention(
+            q, k, v, causal=causal), q, k, v)
+        for name, a, b in zip("qkv", vjp_f(do), vjp_r(do)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"d{name} causal={causal}")
+
+    def test_bwd_is_kernel_not_recompute(self, monkeypatch):
+        """The vjp must run the Pallas backward (flash_block_bwd), not fall
+        back to autodiff through the XLA lowering."""
+        import importlib
+
+        import jax
+
+        fa = importlib.import_module(
+            "deeplearning4j_tpu.ops.pallas.flash_attention")
+        called = []
+        orig = fa._flash_backward
+
+        def spy(*a, **kw):
+            called.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fa, "_flash_backward", spy)
+        q = jnp.ones((1, 1, 256, 128), jnp.float32)
+        jax.grad(lambda q: fa.flash_attention(q, q, q).sum())(q)
+        assert called, "flash backward kernel was not used in the vjp"
+
+    def test_bf16_inputs(self, rng):
+        import jax
+
+        B, H, T, D = 1, 2, 256, 128
+        q = jnp.asarray(rng.normal(size=(B, H, T, D))).astype(jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(B, H, T, D))).astype(jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(B, H, T, D))).astype(jnp.bfloat16)
+        g = jax.grad(lambda q: flash_attention(q, k, v, causal=True)
+                     .astype(jnp.float32).sum())(q)
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(g, np.float32)).all()
