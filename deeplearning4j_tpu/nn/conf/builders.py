@@ -33,6 +33,9 @@ class MultiLayerConfiguration:
     tbptt_fwd_length: int = 0  # 0 = no truncated BPTT
     tbptt_bwd_length: int = 0
     max_grad_norm: float = 0.0  # 0 = no clipping (GradientNormalization analog)
+    remat: bool = False  # rematerialize per-layer activations in backprop
+    # (jax.checkpoint; XLA-native replacement for the reference's workspace
+    # memory tuning: trades recompute FLOPs for activation HBM)
 
     # resolved by build(): per-layer input types
     layer_input_types: list = dataclasses.field(default_factory=list)
@@ -68,6 +71,7 @@ class MultiLayerConfiguration:
                 "tbptt_fwd_length": self.tbptt_fwd_length,
                 "tbptt_bwd_length": self.tbptt_bwd_length,
                 "max_grad_norm": self.max_grad_norm,
+                "remat": self.remat,
             },
             indent=2,
         )
@@ -86,6 +90,7 @@ class MultiLayerConfiguration:
             tbptt_fwd_length=d.get("tbptt_fwd_length", 0),
             tbptt_bwd_length=d.get("tbptt_bwd_length", 0),
             max_grad_norm=d.get("max_grad_norm", 0.0),
+            remat=d.get("remat", False),
         )
         return conf.resolve() if conf.input_type else conf
 
@@ -129,6 +134,7 @@ class ListBuilder:
             tbptt_fwd_length=self._tbptt[0],
             tbptt_bwd_length=self._tbptt[1],
             max_grad_norm=self._base._max_grad_norm,
+            remat=self._base._remat,
         )
         return conf.resolve() if self._input_type else conf
 
@@ -141,6 +147,7 @@ class NeuralNetConfiguration:
         self._updater: Updater = Sgd()
         self._dtype = "float32"
         self._max_grad_norm = 0.0
+        self._remat = False
 
     @staticmethod
     def builder() -> "NeuralNetConfiguration":
@@ -152,6 +159,11 @@ class NeuralNetConfiguration:
 
     def updater(self, u) -> "NeuralNetConfiguration":
         self._updater = get_updater(u)
+        return self
+
+    def gradient_checkpointing(self, on: bool = True) -> "NeuralNetConfiguration":
+        """Remat per-layer activations during backprop (jax.checkpoint)."""
+        self._remat = bool(on)
         return self
 
     def data_type(self, dtype: str) -> "NeuralNetConfiguration":
@@ -189,6 +201,7 @@ class ComputationGraphConfiguration:
     updater: Updater = dataclasses.field(default_factory=lambda: Sgd())
     dtype: str = "float32"
     max_grad_norm: float = 0.0
+    remat: bool = False  # see MultiLayerConfiguration.remat
 
     topological_order: list = dataclasses.field(default_factory=list)
     vertex_output_types: dict = dataclasses.field(default_factory=dict)
@@ -246,6 +259,7 @@ class ComputationGraphConfiguration:
                 "updater": self.updater.to_dict(),
                 "dtype": self.dtype,
                 "max_grad_norm": self.max_grad_norm,
+                "remat": self.remat,
             },
             indent=2,
         )
@@ -267,5 +281,6 @@ class ComputationGraphConfiguration:
             updater=updater_from_dict(d["updater"]),
             dtype=d.get("dtype", "float32"),
             max_grad_norm=d.get("max_grad_norm", 0.0),
+            remat=d.get("remat", False),
         )
         return conf.resolve() if conf.input_types else conf

@@ -278,6 +278,7 @@ class GraphBuilder:
             updater=self._base._updater,
             dtype=self._base._dtype,
             max_grad_norm=self._base._max_grad_norm,
+            remat=getattr(self._base, "_remat", False),
             preprocessors=dict(self._preprocessors),
         )
         return conf.resolve() if self._input_types else conf

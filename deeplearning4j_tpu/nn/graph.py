@@ -119,7 +119,13 @@ class ComputationGraph:
                 if s:
                     new_state[name] = s
                 continue
-            out, s2 = v.apply(p, s, ins, train=train, rng=k, masks=masks)
+            if self.conf.remat and train:
+                out, s2 = jax.checkpoint(
+                    lambda pp, ss, ii, kk, _v=v: _v.apply(
+                        pp, ss, ii, train=True, rng=kk, masks=masks)
+                )(p, s, ins, k)
+            else:
+                out, s2 = v.apply(p, s, ins, train=train, rng=k, masks=masks)
             acts[name] = out
             if s2:
                 new_state[name] = s2

@@ -128,7 +128,16 @@ class MultiLayerNetwork:
                 x = layer._maybe_dropout(x, train, k) if train else x
                 new_states.append(state[i])
                 return layer.preout(params[i], x), new_states, mask, x
-            x, s = layer.apply(params[i], state[i], x, train=train, rng=k, mask=mask)
+            if self.conf.remat and train:
+                # remat policy (workspace-tuning analog): save only each
+                # layer's input; recompute its internals during backprop
+                x, s = jax.checkpoint(
+                    lambda p, st, xx, kk, mm, _l=layer: _l.apply(
+                        p, st, xx, train=True, rng=kk, mask=mm)
+                )(params[i], state[i], x, k, mask)
+            else:
+                x, s = layer.apply(params[i], state[i], x, train=train, rng=k,
+                                   mask=mask)
             mask = layer.feed_forward_mask(mask, itype_chain[i])
             new_states.append(s)
         return x, new_states, mask, x

@@ -352,19 +352,38 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
+def bwd_tiles(block_q, block_k, head_dim, vmem_budget=12 << 20):
+    """VMEM-budget-aware backward tile sizes.
+
+    Measured on v5e: the bwd kernels want much larger tiles than the fwd
+    (1024x1024 is ~3x faster than 128x128 at T=8192 — grid overhead
+    dominates small tiles), but the [bq, bk] f32 probability/ds tiles plus
+    the [tile, D] operands must fit the ~16M scoped-VMEM limit, so large
+    head dims scale the tiles back down. Tiles also clamp to the actual
+    sequence lengths inside _flash_backward."""
+    bq, bk = max(block_q, 1024), max(block_k, 1024)
+
+    def est(bq, bk):
+        return 3 * bq * bk * 4 + 4 * max(bq, bk) * head_dim * 4
+
+    while est(bq, bk) > vmem_budget and max(bq, bk) > 128:
+        if bq >= bk:
+            bq //= 2
+        else:
+            bk //= 2
+    return bq, bk
+
+
 def _flash_bwd(causal, scale, block_q, block_k, res, g):
     # flash backward: only [bq, bk] probability tiles are ever materialized,
     # recomputed from the saved logsumexp — HBM stays O(T*D), which is what
-    # makes long-context *training* (not just inference) sub-quadratic.
-    # Measured on v5e: the bwd kernels want much larger tiles than the fwd
-    # (1024x1024 is ~3x faster than 128x128 at T=8192 — grid overhead
-    # dominates small tiles); clamped to T inside _flash_backward.
+    # makes long-context *training* (not just inference) sub-quadratic
     q, k, v, out, lse = res
+    bq, bk = bwd_tiles(block_q, block_k, q.shape[-1])
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(
         axis=-1, keepdims=True)
     dq, dk, dv = _flash_backward(q, k, v, g, lse, delta, causal=causal,
-                                 scale=scale, block_q=max(block_q, 1024),
-                                 block_k=max(block_k, 1024),
+                                 scale=scale, block_q=bq, block_k=bk,
                                  interpret=_interpret())
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -150,10 +150,12 @@ def _ring_flash_vjp_bwd(axis, causal, scale, block_q, block_k, res, do):
     dk_carry = _pvary(jnp.zeros(k.shape, jnp.float32), (axis,))
     dv_carry = _pvary(jnp.zeros(v.shape, jnp.float32), (axis,))
     k_cur, v_cur = k, v
-    # bwd kernels want large tiles (see ops/pallas/flash_attention._flash_bwd)
+    # bwd kernels want large tiles, bounded by VMEM (see bwd_tiles)
+    from deeplearning4j_tpu.ops.pallas.flash_attention import bwd_tiles
+
+    bwq, bwk = bwd_tiles(block_q, block_k, q.shape[-1])
     blk = functools.partial(flash_block_bwd, scale=scale,
-                            block_q=max(block_q, 1024), block_k=max(block_k, 1024),
-                            vma=(axis,))
+                            block_q=bwq, block_k=bwk, vma=(axis,))
     for i in range(n):
         if i == 0:
             dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta, causal=causal)
@@ -196,6 +198,17 @@ def _flash_core_ok(head_dim: int, t_local: int) -> bool:
     return head_dim % 128 == 0 and t_local % 8 == 0 and t_local >= 8
 
 
+def _select_ring_core(head_dim: int, t_local: int):
+    """(local_fn, check_vma) for the ring attention core — single decision
+    point shared by ring_attention and sequence_parallel_encoder. The Pallas
+    core needs the VMA checker off (pallas_call in interpret mode can't
+    satisfy it yet — jax hlo_interpreter dynamic_slice limitation); the
+    einsum path keeps full checking."""
+    if _flash_core_ok(head_dim, t_local):
+        return _ring_flash_local, False
+    return _ring_attention_local, True
+
+
 def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
                    scale: float | None = None, impl: str | None = None):
     """Ring attention over a mesh axis.
@@ -210,17 +223,17 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
         scale = 1.0 / (q.shape[-1] ** 0.5)
     size = mesh.shape[axis]
     if impl is None:
-        impl = "flash" if _flash_core_ok(q.shape[-1], q.shape[2] // size) else "einsum"
-    local = _ring_flash_local if impl == "flash" else _ring_attention_local
+        local, check_vma = _select_ring_core(q.shape[-1], q.shape[2] // size)
+    elif impl == "flash":
+        local, check_vma = _ring_flash_local, False
+    else:
+        local, check_vma = _ring_attention_local, True
     fn = shard_map(
         functools.partial(local, axis=axis, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),
-        # pallas_call in interpret mode can't satisfy the VMA checker yet
-        # (jax hlo_interpreter dynamic_slice limitation); the einsum path
-        # keeps full checking
-        check_vma=impl != "flash",
+        check_vma=check_vma,
     )
     return fn(q, k, v)
 
@@ -289,11 +302,12 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         _ulysses_causal_guard(n_heads, mesh, axis)
     elif impl != "ring":
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
-    # decided here (not in the traced body) so check_vma below can match:
-    # the Pallas ring core needs the VMA checker off in interpret mode
-    _dh = x.shape[-1] // n_heads
-    _tl = x.shape[1] // mesh.shape[axis]
-    ring_flash = impl == "ring" and _flash_core_ok(_dh, _tl)
+    # decided here (not in the traced body) so check_vma below can match
+    if impl == "ring":
+        _ring_local, _check_vma = _select_ring_core(
+            x.shape[-1] // n_heads, x.shape[1] // mesh.shape[axis])
+    else:
+        _ring_local, _check_vma = None, True
 
     def _ln(h, g, b):
         m = h.mean(-1, keepdims=True)
@@ -314,12 +328,7 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         q = heads(p["Wq"], p["bq"])
         k = heads(p["Wk"], p["bk"])
         v = heads(p["Wv"], p["bv"])
-        if impl == "ulysses":
-            local = _ulysses_local
-        elif ring_flash:
-            local = _ring_flash_local
-        else:
-            local = _ring_attention_local
+        local = _ulysses_local if impl == "ulysses" else _ring_local
         a = local(q, k, v, axis=axis, causal=causal, scale=scale)
         a = a.transpose(0, 2, 1, 3).reshape(B, Tl, D) @ p["Wo"] + p["bo"]
         xl = xl + a
@@ -332,6 +341,6 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         block, mesh=mesh,
         in_specs=(P(), P(None, axis, None)),
         out_specs=P(None, axis, None),
-        check_vma=not ring_flash,
+        check_vma=_check_vma,
     )
     return fn(params, x)

@@ -213,3 +213,55 @@ class TestDonationCorrectness:
             "train step no longer donates its param buffers"
         # and the live params are intact and usable
         assert np.isfinite(np.asarray(model.params[0]["W"])).all()
+
+
+class TestRemat:
+    """gradient_checkpointing() (jax.checkpoint per layer) must not change
+    numerics — identical losses and params vs the non-remat network; it only
+    trades backprop HBM for recompute FLOPs (the workspace-tuning analog)."""
+
+    def test_remat_matches_plain(self, rng):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        def build(remat):
+            b = NeuralNetConfiguration.builder().seed(7)
+            if remat:
+                b = b.gradient_checkpointing()
+            conf = (b.list()
+                    .layer(DenseLayer(n_out=32, activation="tanh"))
+                    .layer(DenseLayer(n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(8)).build())
+            assert conf.remat == remat
+            return MultiLayerNetwork(conf).init()
+
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        plain, ckpt = build(False), build(True)
+        for _ in range(3):
+            lp = plain.fit_batch((x, y))
+            lc = ckpt.fit_batch((x, y))
+        np.testing.assert_allclose(float(lp), float(lc), rtol=1e-6)
+        for a, b in zip(plain.params, ckpt.params):
+            for k in a:
+                np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                           rtol=1e-6, atol=1e-7)
+
+    def test_remat_json_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.builders import (
+            MultiLayerConfiguration, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+
+        conf = (NeuralNetConfiguration.builder().gradient_checkpointing().list()
+                .layer(DenseLayer(n_out=8))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        assert MultiLayerConfiguration.from_json(conf.to_json()).remat
