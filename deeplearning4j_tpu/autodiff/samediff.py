@@ -1153,3 +1153,9 @@ class SameDiff:
         sd.loss_name = meta.get("loss")
         sd._counter = meta.get("counter", len(meta["nodes"]))
         return sd
+
+
+# Extended declarable-op families (linalg/random/segment/image/sort/bitwise/
+# distances/NN/losses) + the sd.math/sd.nn/... namespaces. Imported last so
+# the registry and SameDiff class exist; the import completes the catalog.
+from deeplearning4j_tpu.autodiff import sd_ops as _sd_ops  # noqa: E402,F401
