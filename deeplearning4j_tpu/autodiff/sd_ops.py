@@ -973,12 +973,6 @@ class _Namespace:
     SDVariable tuples (via tuple_get selector nodes)."""
 
     _ALIASES: dict[str, str] = {}
-    _NULLARY = frozenset({"linspace", "range", "eye", "random_normal",
-                          "random_uniform", "random_bernoulli", "random_gamma",
-                          "random_poisson", "random_exponential",
-                          "random_truncated_normal", "random_laplace",
-                          "random_cauchy", "random_gumbel", "random_beta",
-                          "random_randint"})
 
     def __init__(self, sd: SameDiff, prefix: str = ""):
         self._sd = sd

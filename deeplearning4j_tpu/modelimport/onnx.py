@@ -61,7 +61,10 @@ def _parse_onnx_tensor(buf: bytes) -> tuple:
         arr = np.asarray(_varints(f[5]), np.int32)
     else:
         arr = np.zeros(dims, dtype)
-    return name, arr.reshape(dims) if dims else arr
+    # dims == [] is a RANK-0 tensor (TensorProto omits the dims field for
+    # scalars); reshape(()) matters — Gather with a scalar index drops the
+    # axis, with a [1]-shaped index it keeps it
+    return name, arr.reshape(dims) if (dims or arr.size == 1) else arr
 
 
 class OnnxAttr:
@@ -490,6 +493,367 @@ def _bn(node, xs):
 
 
 # ------------------------------------------------------------- the importer
+
+
+
+
+# ---- torch-exporter op families (real-framework graphs: BERT/ResNet via
+# torch.onnx.export) + general breadth: constants, shapes, slicing, casts,
+# comparisons, reductions, norms, scatter/gather, resize, topk ----
+
+_ONNX_ATTR_DTYPES = _ONNX_DTYPES  # AttributeProto "to"/"dtype" share codes
+
+
+@onnx_op("Constant")
+def _constant(node, xs):
+    a = node.attr("value")
+    if a is not None and a.t is not None:
+        return np.asarray(a.t)  # numpy: downstream static reads stay concrete
+    for nm in ("value_float", "value_int"):
+        v = node.attr(nm)
+        if v is not None:
+            return np.asarray(v.f if nm == "value_float" else v.i)
+    ints = node.ints("value_ints")
+    if ints:
+        return np.asarray(ints, np.int64)
+    raise NotImplementedError("Constant node without a supported value attr")
+
+
+@onnx_op("ConstantOfShape")
+def _constant_of_shape(node, xs):
+    shape = [int(v) for v in np.asarray(xs[0]).ravel()]
+    a = node.attr("value")
+    fill = np.asarray(a.t) if a is not None and a.t is not None \
+        else np.zeros(1, np.float32)
+    return np.full(shape, fill.ravel()[0], fill.dtype)
+
+
+@onnx_op("Shape")
+def _shape(node, xs):
+    # numpy (concrete): shapes feed Reshape/Expand/Slice as static arguments
+    return np.asarray(np.shape(xs[0]), np.int64)
+
+
+@onnx_op("Size")
+def _size(node, xs):
+    return np.asarray(np.size(xs[0]), np.int64)
+
+
+@onnx_op("Cast")
+def _cast(node, xs):
+    to = node.attr("to")
+    dt = _ONNX_ATTR_DTYPES.get(to.i if to is not None else 1, np.float32)
+    # works for numpy constants and jax arrays alike; numpy stays concrete
+    return xs[0].astype(dt)
+
+
+@onnx_op("Slice")
+def _slice(node, xs):
+    x = xs[0]
+    starts = _const_ints(node, xs, "starts", 1)
+    ends = _const_ints(node, xs, "ends", 2)
+    axes = _const_ints(node, xs, "axes", 3)
+    steps = _const_ints(node, xs, "steps", 4)
+    axes = axes if axes is not None else list(range(len(starts)))
+    steps = steps if steps is not None else [1] * len(starts)
+    sl = [slice(None)] * x.ndim
+    INT64_MAX = (1 << 63) - 1
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        en_v = None if en >= INT64_MAX // 2 else en
+        st_v = None if (sp < 0 and st >= INT64_MAX // 2) else st
+        sl[ax % x.ndim] = slice(st_v, en_v, sp)
+    return x[tuple(sl)]
+
+
+@onnx_op("Min")
+def _min_v(node, xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.minimum(out, x)
+    return out
+
+
+@onnx_op("Max")
+def _max_v(node, xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = jnp.maximum(out, x)
+    return out
+
+
+@onnx_op("Sum")
+def _sum_v(node, xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@onnx_op("Mean")
+def _mean_v(node, xs):
+    return _sum_v(node, xs) / len(xs)
+
+
+@onnx_op("Mod")
+def _mod(node, xs):
+    fm = node.attr("fmod")
+    return jnp.fmod(xs[0], xs[1]) if fm is not None and fm.i else \
+        jnp.mod(xs[0], xs[1])
+
+
+for _nm, _fn in [
+        ("Floor", jnp.floor), ("Ceil", jnp.ceil), ("Round", jnp.round),
+        ("Reciprocal", jnp.reciprocal), ("Sign", jnp.sign), ("Abs", jnp.abs),
+        ("Cos", jnp.cos), ("Sin", jnp.sin), ("Tan", jnp.tan),
+        ("Acos", jnp.arccos), ("Asin", jnp.arcsin), ("Atan", jnp.arctan),
+        ("Cosh", jnp.cosh), ("Sinh", jnp.sinh), ("Atanh", jnp.arctanh),
+        ("Asinh", jnp.arcsinh), ("Acosh", jnp.arccosh),
+        ("IsNaN", jnp.isnan), ("Not", jnp.logical_not),
+        ("Softsign", jax.nn.soft_sign), ("Mish", lambda x: x * jnp.tanh(
+            jax.nn.softplus(x)))]:
+    ONNX_OP_REGISTRY[_nm] = (lambda _f: lambda node, xs: _f(xs[0]))(_fn)
+
+for _nm, _fn in [("Greater", jnp.greater), ("Less", jnp.less),
+                 ("GreaterOrEqual", jnp.greater_equal),
+                 ("LessOrEqual", jnp.less_equal), ("And", jnp.logical_and),
+                 ("Or", jnp.logical_or), ("Xor", jnp.logical_xor)]:
+    ONNX_OP_REGISTRY[_nm] = (lambda _f: lambda node, xs: _f(xs[0], xs[1]))(_fn)
+
+
+def _reduce_generic(jfn, default_keepdims=True):
+    def fn(node, xs):
+        axes = _const_ints(node, xs, "axes", 1)
+        kd = node.attr("keepdims")
+        noop = node.attr("noop_with_empty_axes")
+        if not axes and noop is not None and noop.i:
+            return xs[0]
+        return jfn(xs[0], axis=tuple(axes) if axes else None,
+                   keepdims=bool(kd.i) if kd is not None else default_keepdims)
+    return fn
+
+
+ONNX_OP_REGISTRY["ReduceMax"] = _reduce_generic(jnp.max)
+ONNX_OP_REGISTRY["ReduceMin"] = _reduce_generic(jnp.min)
+ONNX_OP_REGISTRY["ReduceProd"] = _reduce_generic(jnp.prod)
+ONNX_OP_REGISTRY["ReduceL1"] = _reduce_generic(
+    lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims))
+ONNX_OP_REGISTRY["ReduceL2"] = _reduce_generic(
+    lambda a, axis, keepdims: jnp.sqrt(jnp.sum(a * a, axis=axis,
+                                               keepdims=keepdims)))
+ONNX_OP_REGISTRY["ReduceLogSumExp"] = _reduce_generic(
+    lambda a, axis, keepdims: jax.scipy.special.logsumexp(a, axis=axis,
+                                                          keepdims=keepdims))
+ONNX_OP_REGISTRY["ReduceSumSquare"] = _reduce_generic(
+    lambda a, axis, keepdims: jnp.sum(a * a, axis=axis, keepdims=keepdims))
+
+
+@onnx_op("ArgMax")
+def _argmax(node, xs):
+    ax = node.attr("axis")
+    kd = node.attr("keepdims")
+    out = jnp.argmax(xs[0], axis=ax.i if ax is not None else 0)
+    if kd is None or kd.i:
+        out = jnp.expand_dims(out, ax.i if ax is not None else 0)
+    return out
+
+
+@onnx_op("ArgMin")
+def _argmin(node, xs):
+    ax = node.attr("axis")
+    kd = node.attr("keepdims")
+    out = jnp.argmin(xs[0], axis=ax.i if ax is not None else 0)
+    if kd is None or kd.i:
+        out = jnp.expand_dims(out, ax.i if ax is not None else 0)
+    return out
+
+
+@onnx_op("LogSoftmax")
+def _log_softmax(node, xs):
+    ax = node.attr("axis")
+    return jax.nn.log_softmax(xs[0], axis=ax.i if ax is not None else -1)
+
+
+@onnx_op("Elu")
+def _elu(node, xs):
+    a = node.attr("alpha")
+    return jax.nn.elu(xs[0], a.f if a is not None else 1.0)
+
+
+@onnx_op("Selu")
+def _selu(node, xs):
+    return jax.nn.selu(xs[0])
+
+
+@onnx_op("Celu")
+def _celu(node, xs):
+    a = node.attr("alpha")
+    return jax.nn.celu(xs[0], a.f if a is not None else 1.0)
+
+
+@onnx_op("HardSigmoid")
+def _hard_sigmoid(node, xs):
+    a = node.attr("alpha")
+    b = node.attr("beta")
+    return jnp.clip((a.f if a is not None else 0.2) * xs[0]
+                    + (b.f if b is not None else 0.5), 0.0, 1.0)
+
+
+@onnx_op("HardSwish")
+def _hard_swish(node, xs):
+    return jax.nn.hard_swish(xs[0])
+
+
+@onnx_op("PRelu")
+def _prelu(node, xs):
+    return jnp.where(xs[0] >= 0, xs[0], xs[1] * xs[0])
+
+
+@onnx_op("Softplus")
+def _softplus_onnx(node, xs):
+    return jax.nn.softplus(xs[0])
+
+
+@onnx_op("Tile")
+def _tile_onnx(node, xs):
+    reps = [int(v) for v in np.asarray(xs[1]).ravel()]
+    return jnp.tile(xs[0], reps)
+
+
+@onnx_op("Range")
+def _range(node, xs):
+    start, limit, delta = (np.asarray(v).item() for v in xs[:3])
+    return np.arange(start, limit, delta)
+
+
+@onnx_op("CumSum")
+def _cumsum(node, xs):
+    axis = int(np.asarray(xs[1]).item())
+    return jnp.cumsum(xs[0], axis=axis)
+
+
+@onnx_op("OneHot")
+def _one_hot(node, xs):
+    depth = int(np.asarray(xs[1]).item())
+    values = np.asarray(xs[2]).ravel()  # [off, on]
+    ax = node.attr("axis")
+    axis = ax.i if ax is not None and ax.i is not None else -1
+    oh = jax.nn.one_hot(jnp.asarray(xs[0]).astype(jnp.int32), depth, axis=axis)
+    return oh * (values[1] - values[0]) + values[0]
+
+
+@onnx_op("TopK")
+def _topk(node, xs):
+    k = int(np.asarray(xs[1]).item()) if len(xs) > 1 else node.attr("k").i
+    ax = node.attr("axis")
+    axis = ax.i if ax is not None and ax.i is not None else -1
+    lg = node.attr("largest")
+    largest = bool(lg.i) if lg is not None and lg.i is not None else True
+    x = jnp.moveaxis(xs[0], axis, -1)
+    v, i = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        v = -v
+    return (jnp.moveaxis(v, -1, axis),
+            jnp.moveaxis(i, -1, axis).astype(jnp.int64))
+
+
+@onnx_op("Einsum")
+def _einsum(node, xs):
+    eq = node.attr("equation").s
+    return jnp.einsum(eq, *xs)
+
+
+@onnx_op("Trilu")
+def _trilu(node, xs):
+    upper = node.attr("upper")
+    k = int(np.asarray(xs[1]).item()) if _opt(xs, 1) is not None else 0
+    if upper is None or upper.i:
+        return jnp.triu(xs[0], k)
+    return jnp.tril(xs[0], k)
+
+
+@onnx_op("GatherElements")
+def _gather_elements(node, xs):
+    ax = node.attr("axis")
+    return jnp.take_along_axis(xs[0], jnp.asarray(xs[1]).astype(jnp.int32),
+                               axis=ax.i if ax is not None else 0)
+
+
+@onnx_op("GatherND")
+def _gather_nd(node, xs):
+    idx = jnp.asarray(xs[1]).astype(jnp.int32)
+    return xs[0][tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@onnx_op("ScatterND")
+def _scatter_nd(node, xs):
+    data, idx, upd = xs[0], jnp.asarray(xs[1]).astype(jnp.int32), xs[2]
+    return jnp.asarray(data).at[tuple(jnp.moveaxis(idx, -1, 0))].set(upd)
+
+
+@onnx_op("ScatterElements")
+def _scatter_elements(node, xs):
+    ax = node.attr("axis")
+    axis = (ax.i if ax is not None else 0) % np.ndim(xs[0])
+    red = node.attr("reduction")
+    grids = jnp.meshgrid(*[jnp.arange(d) for d in xs[1].shape], indexing="ij")
+    idx = (tuple(grids[:axis]) + (jnp.asarray(xs[1]).astype(jnp.int32),)
+           + tuple(grids[axis + 1:]))
+    ref = jnp.asarray(xs[0]).at[idx]
+    method = {"add": ref.add, "mul": ref.multiply, "max": ref.max,
+              "min": ref.min}.get(red.s if red is not None else "none",
+                                  ref.set)
+    return method(xs[2])
+
+
+@onnx_op("InstanceNormalization")
+def _instance_norm(node, xs):
+    eps = node.attr("epsilon")
+    eps_v = eps.f if eps is not None else 1e-5
+    x, scale, bias = xs[0], xs[1], xs[2]  # NCHW: stats over spatial dims
+    axes = tuple(range(2, x.ndim))
+    m = x.mean(axes, keepdims=True)
+    v = x.var(axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - m) / jnp.sqrt(v + eps_v) * scale.reshape(shape) \
+        + bias.reshape(shape)
+
+
+@onnx_op("GroupNormalization")
+def _group_norm_onnx(node, xs):
+    eps = node.attr("epsilon")
+    eps_v = eps.f if eps is not None else 1e-5
+    groups = node.attr("num_groups").i
+    x, scale, bias = xs[0], xs[1], xs[2]  # NCHW
+    B, C = x.shape[0], x.shape[1]
+    xg = x.reshape((B, groups, C // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    m = xg.mean(axes, keepdims=True)
+    v = xg.var(axes, keepdims=True)
+    xg = (xg - m) / jnp.sqrt(v + eps_v)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return xg.reshape(x.shape) * scale.reshape(shape) + bias.reshape(shape)
+
+
+@onnx_op("Resize")
+def _resize(node, xs):
+    mode = node.attr("mode")
+    mode_s = mode.s if mode is not None else "nearest"
+    jmethod = {"nearest": "nearest", "linear": "linear",
+               "cubic": "cubic"}[mode_s]
+    x = xs[0]
+    sizes = _opt(xs, 3)
+    if sizes is not None:
+        out_shape = tuple(int(v) for v in np.asarray(sizes).ravel())
+    else:
+        scales = np.asarray(_opt(xs, 2)).ravel()
+        out_shape = tuple(int(round(d * sc))
+                          for d, sc in zip(x.shape, scales))
+    return jax.image.resize(x, out_shape, method=jmethod)
+
+
+@onnx_op("GlobalMaxPool")
+def _gmp(node, xs):
+    return jnp.max(xs[0], axis=tuple(range(2, xs[0].ndim)), keepdims=True)
 
 
 class OnnxImportedGraph:

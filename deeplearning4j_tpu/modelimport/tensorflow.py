@@ -92,7 +92,9 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
     shape = _parse_shape(f[2][0]) if 2 in f else []
     if 4 in f and f[4][0]:  # tensor_content: raw bytes
         arr = np.frombuffer(f[4][0], dtype=dtype)
-        return arr.reshape(shape) if shape else arr
+        # shape == [] is a RANK-0 tensor; the reshape matters for control
+        # flow (a scalar loop counter must stay int32[], not int32[1])
+        return arr.reshape(shape) if (shape or arr.size == 1) else arr
 
     def fixed_vals(raws, fmt, width):
         # a raw entry is either one unpacked fixed value (wire type 5/1,
@@ -127,7 +129,7 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
             n = int(np.prod(shape)) if shape else len(arr)
             if len(arr) == 1 and n > 1:  # single-value splat convention
                 arr = np.full(n, arr[0], dt)
-            return arr.reshape(shape) if shape else arr
+            return arr.reshape(shape) if (shape or arr.size == 1) else arr
     return np.zeros(shape, dtype)
 
 
@@ -141,6 +143,12 @@ class AttrValue:
         self.type = f[6][0] if 6 in f else None
         self.shape = _parse_shape(f[7][0]) if 7 in f else None
         self.tensor = _parse_tensor(f[8][0]) if 8 in f else None
+        # field 10: NameAttrList func (If/While branch and body references)
+        self.func_name = None
+        if 10 in f:
+            nf = parse_message(f[10][0])
+            if 1 in nf:
+                self.func_name = nf[1][0].decode()
         self.list_i: List[int] = []
         self.list_s: List[str] = []
         if 1 in f:  # ListValue
@@ -172,9 +180,46 @@ class NodeDef:
         return self.attrs.get(key, default)
 
 
+class TFFunction:
+    """FunctionDef: signature(OpDef)=1, node_def=3, ret=4.
+
+    TF2 control flow (If/While/PartitionedCall) stores branch/body graphs as
+    functions in GraphDef.library — the reference's TFGraphMapper-era
+    importer predates this; here each function is a mini graph executed by
+    the same node loop (SURVEY.md §3.4's topological exec, one level down).
+    """
+
+    def __init__(self, fbuf: bytes):
+        f = parse_message(fbuf)
+        sig = parse_message(f[1][0])
+        self.name = sig[1][0].decode()
+        self.in_args = [parse_message(b)[1][0].decode()
+                        for b in sig.get(2, [])]
+        self.out_args = [parse_message(b)[1][0].decode()
+                         for b in sig.get(3, [])]
+        self.nodes = [NodeDef(b) for b in f.get(3, [])]
+        self.ret: Dict[str, str] = {}
+        for entry in f.get(4, []):
+            ef = parse_message(entry)
+            self.ret[ef[1][0].decode()] = ef[2][0].decode()
+
+
 def parse_graph_def(buf: bytes) -> List[NodeDef]:
     fields = parse_message(buf)
     return [NodeDef(b) for b in fields.get(1, [])]
+
+
+def parse_graph(buf: bytes):
+    """(nodes, functions) — GraphDef field 1 = node, field 2 = library."""
+    fields = parse_message(buf)
+    nodes = [NodeDef(b) for b in fields.get(1, [])]
+    functions: Dict[str, TFFunction] = {}
+    if 2 in fields:
+        lib = parse_message(fields[2][0])
+        for fb in lib.get(1, []):
+            fn = TFFunction(fb)
+            functions[fn.name] = fn
+    return nodes, functions
 
 
 # --------------------------------------------------------------- op mapping
@@ -538,15 +583,368 @@ def _fused_bn(node, xs):
     return x * inv + (offset - mean * inv)
 
 
+
+
+# ---- breadth families: comparisons/selects, shape/packing, image resize,
+# indexed ops, reductions — the EfficientNet/MobileNet/BERT-era frozen-graph
+# vocabulary beyond the core CNN set ----
+
+for _nm, _f in [("Greater", jnp.greater), ("GreaterEqual", jnp.greater_equal),
+                ("Less", jnp.less), ("LessEqual", jnp.less_equal),
+                ("Equal", jnp.equal), ("NotEqual", jnp.not_equal),
+                ("LogicalAnd", jnp.logical_and), ("LogicalOr", jnp.logical_or),
+                ("FloorDiv", jnp.floor_divide), ("FloorMod", jnp.mod),
+                ("Atan2", jnp.arctan2), ("Mod", jnp.mod)]:
+    TF_OP_REGISTRY[_nm] = (lambda _fn: lambda node, xs: _fn(xs[0], xs[1]))(_f)
+
+for _nm, _f in [("LogicalNot", jnp.logical_not), ("Floor", jnp.floor),
+                ("Ceil", jnp.ceil), ("Round", jnp.round), ("Rint", jnp.rint),
+                ("Sign", jnp.sign), ("Log1p", jnp.log1p), ("Expm1", jnp.expm1),
+                ("Sin", jnp.sin), ("Cos", jnp.cos), ("Tan", jnp.tan),
+                ("Asin", jnp.arcsin), ("Acos", jnp.arccos),
+                ("Atan", jnp.arctan), ("Sinh", jnp.sinh), ("Cosh", jnp.cosh),
+                ("Asinh", jnp.arcsinh), ("Acosh", jnp.arccosh),
+                ("Atanh", jnp.arctanh), ("Reciprocal", jnp.reciprocal),
+                ("IsNan", jnp.isnan), ("IsInf", jnp.isinf),
+                ("IsFinite", jnp.isfinite), ("Elu", jax.nn.elu),
+                ("Selu", jax.nn.selu), ("Swish", jax.nn.silu),
+                ("SiLU", jax.nn.silu), ("Softsign", jax.nn.soft_sign),
+                ("ZerosLike", jnp.zeros_like), ("OnesLike", jnp.ones_like),
+                ("Snapshot", lambda x: x)]:
+    TF_OP_REGISTRY[_nm] = (lambda _fn: lambda node, xs: _fn(xs[0]))(_f)
+
+
+@tf_op("Select", "SelectV2")
+def _select(node, xs):
+    return jnp.where(xs[0], xs[1], xs[2])
+
+
+@tf_op("Shape")
+def _shape_tf(node, xs):
+    # concrete numpy so downstream Reshape/Fill/StridedSlice stay static
+    return np.asarray(np.shape(xs[0]), np.int64)
+
+
+@tf_op("ShapeN")
+def _shape_n(node, xs):
+    return tuple(np.asarray(np.shape(x), np.int64) for x in xs)
+
+
+@tf_op("Size")
+def _size_tf(node, xs):
+    return np.asarray(np.size(xs[0]), np.int64)
+
+
+@tf_op("Rank")
+def _rank_tf(node, xs):
+    return np.asarray(np.ndim(xs[0]), np.int32)
+
+
+@tf_op("Fill")
+def _fill(node, xs):
+    dims = [int(v) for v in np.asarray(xs[0]).ravel()]
+    return jnp.full(dims, xs[1])
+
+
+@tf_op("Range")
+def _range_tf(node, xs):
+    start, limit, delta = (np.asarray(v).item() for v in xs[:3])
+    return np.arange(start, limit, delta)
+
+
+@tf_op("Pack")
+def _pack(node, xs):
+    a = node.attr("axis")
+    return jnp.stack(xs, axis=a.i if a is not None and a.i is not None else 0)
+
+
+@tf_op("Unpack")
+def _unpack(node, xs):
+    a = node.attr("axis")
+    axis = a.i if a is not None and a.i is not None else 0
+    n = node.attr("num").i
+    return tuple(jnp.squeeze(p, axis) for p in jnp.split(xs[0], n, axis=axis))
+
+
+@tf_op("Split")
+def _split_tf(node, xs):
+    axis = int(np.asarray(xs[0]).item())
+    n = node.attr("num_split").i
+    return tuple(jnp.split(xs[1], n, axis=axis))
+
+
+@tf_op("SplitV")
+def _split_v(node, xs):
+    sizes = [int(v) for v in np.asarray(xs[1]).ravel()]
+    axis = int(np.asarray(xs[2]).item())
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(xs[0], idx, axis=axis))
+
+
+def _tf_resize_coords(node, out_size, in_size):
+    """TF coordinate mapping: default is the ASYMMETRIC map src = dst*scale
+    (neither jax.image.resize's half-pixel nor align-corners)."""
+    ac = node.attr("align_corners")
+    hp = node.attr("half_pixel_centers")
+    out = jnp.arange(out_size, dtype=jnp.float32)
+    if hp is not None and hp.b:
+        return (out + 0.5) * (in_size / out_size) - 0.5
+    if ac is not None and ac.b and out_size > 1:
+        return out * ((in_size - 1) / (out_size - 1))
+    return out * (in_size / out_size)
+
+
+@tf_op("ResizeBilinear")
+def _resize_bilinear_tf(node, xs):
+    h, w = (int(v) for v in np.asarray(xs[1]).ravel())
+    x = xs[0]
+
+    def lerp_axis(x, coords, axis):
+        lo = jnp.clip(jnp.floor(coords), 0, x.shape[axis] - 1).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, x.shape[axis] - 1)
+        t = jnp.clip(coords - lo, 0.0, 1.0)
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        a = jnp.take(x, lo, axis=axis)
+        b = jnp.take(x, hi, axis=axis)
+        return a + (b - a) * t.reshape(shape)
+
+    x = lerp_axis(x, _tf_resize_coords(node, h, x.shape[1]), 1)
+    return lerp_axis(x, _tf_resize_coords(node, w, x.shape[2]), 2)
+
+
+@tf_op("ResizeNearestNeighbor")
+def _resize_nearest_tf(node, xs):
+    h, w = (int(v) for v in np.asarray(xs[1]).ravel())
+    x = xs[0]
+    ac = node.attr("align_corners")
+    hp = node.attr("half_pixel_centers")
+
+    def pick(out_size, in_size):
+        c = _tf_resize_coords(node, out_size, in_size)
+        if hp is not None and hp.b:
+            idx = jnp.floor(c + 0.5)  # TF half-pixel nearest: floor(x+0.5)
+        elif ac is not None and ac.b:
+            idx = jnp.round(c)
+        else:
+            idx = jnp.floor(c)
+        return jnp.clip(idx, 0, in_size - 1).astype(jnp.int32)
+
+    x = jnp.take(x, pick(h, x.shape[1]), axis=1)
+    return jnp.take(x, pick(w, x.shape[2]), axis=2)
+
+
+@tf_op("MirrorPad")
+def _mirror_pad(node, xs):
+    mode = node.attr("mode")
+    m = (mode.s if mode is not None and mode.s else "REFLECT").lower()
+    pads = [tuple(int(v) for v in p) for p in np.asarray(xs[1])]
+    return jnp.pad(xs[0], pads, mode="reflect" if m == "reflect"
+                   else "symmetric")
+
+
+@tf_op("SpaceToDepth")
+def _space_to_depth_tf(node, xs):
+    bs = node.attr("block_size").i
+    x = xs[0]
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // bs, bs, W // bs, bs, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // bs, W // bs,
+                                                 bs * bs * C)
+
+
+@tf_op("DepthToSpace")
+def _depth_to_space_tf(node, xs):
+    bs = node.attr("block_size").i
+    x = xs[0]
+    B, H, W, C = x.shape
+    x = x.reshape(B, H, W, bs, bs, C // (bs * bs))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H * bs, W * bs,
+                                                 C // (bs * bs))
+
+
+@tf_op("ArgMax")
+def _argmax_tf(node, xs):
+    axis = int(np.asarray(xs[1]).item()) if len(xs) > 1 else 0
+    return jnp.argmax(xs[0], axis=axis)
+
+
+@tf_op("ArgMin")
+def _argmin_tf(node, xs):
+    axis = int(np.asarray(xs[1]).item()) if len(xs) > 1 else 0
+    return jnp.argmin(xs[0], axis=axis)
+
+
+@tf_op("Cumsum")
+def _cumsum_tf(node, xs):
+    axis = int(np.asarray(xs[1]).item())
+    rev = node.attr("reverse")
+    ex = node.attr("exclusive")
+    x = xs[0]
+    if rev is not None and rev.b:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if ex is not None and ex.b:
+        out = jnp.roll(out, 1, axis).at[(slice(None),) * (axis % x.ndim)
+                                        + (0,)].set(0)
+    if rev is not None and rev.b:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@tf_op("TopKV2")
+def _topk_tf(node, xs):
+    k = int(np.asarray(xs[1]).item())
+    v, i = jax.lax.top_k(xs[0], k)
+    return v, i.astype(jnp.int32)
+
+
+@tf_op("Einsum")
+def _einsum_tf(node, xs):
+    eq = node.attr("equation").s
+    return jnp.einsum(eq, *xs)
+
+
+@tf_op("Prod")
+def _prod_tf(node, xs):
+    axes = tuple(int(v) for v in np.asarray(xs[1]).ravel())
+    kd = node.attr("keep_dims")
+    # axis=() is the TF identity-reduce, NOT reduce-all
+    return jnp.prod(xs[0], axis=axes,
+                    keepdims=bool(kd.b) if kd is not None else False)
+
+
+@tf_op("Min")
+def _min_tf(node, xs):
+    axes = tuple(int(v) for v in np.asarray(xs[1]).ravel())
+    kd = node.attr("keep_dims")
+    # axis=() is the TF identity-reduce, NOT reduce-all
+    return jnp.min(xs[0], axis=axes,
+                   keepdims=bool(kd.b) if kd is not None else False)
+
+
+@tf_op("All")
+def _all_tf(node, xs):
+    axes = tuple(int(v) for v in np.asarray(xs[1]).ravel())
+    kd = node.attr("keep_dims")
+    # axis=() is the TF identity-reduce, NOT reduce-all
+    return jnp.all(xs[0], axis=axes,
+                   keepdims=bool(kd.b) if kd is not None else False)
+
+
+@tf_op("Any")
+def _any_tf(node, xs):
+    axes = tuple(int(v) for v in np.asarray(xs[1]).ravel())
+    kd = node.attr("keep_dims")
+    # axis=() is the TF identity-reduce, NOT reduce-all
+    return jnp.any(xs[0], axis=axes,
+                   keepdims=bool(kd.b) if kd is not None else False)
+
+
+@tf_op("L2Loss")
+def _l2_loss_tf(node, xs):
+    return 0.5 * jnp.sum(xs[0] * xs[0])
+
+
+@tf_op("LRN")
+def _lrn_tf(node, xs):
+    from deeplearning4j_tpu.ops.registry import op as _rop
+    dr = node.attr("depth_radius")
+    bias = node.attr("bias")
+    alpha = node.attr("alpha")
+    beta = node.attr("beta")
+    depth = (dr.i if dr is not None else 5) * 2 + 1
+    a = alpha.f if alpha is not None else 1.0
+    return _rop("lrn")(xs[0], depth=depth,
+                       bias=bias.f if bias is not None else 1.0,
+                       alpha=a * depth, beta=beta.f if beta is not None else 0.5)
+
+
+@tf_op("BatchToSpaceND")
+def _batch_to_space(node, xs):
+    x, block, crops = xs[0], np.asarray(xs[1]).ravel(), np.asarray(xs[2])
+    B = x.shape[0]
+    nb = int(np.prod(block))
+    spatial = x.shape[1:1 + len(block)]
+    rest = x.shape[1 + len(block):]
+    x = x.reshape(tuple(block) + (B // nb,) + spatial + rest)
+    nd = len(block)
+    perm = [nd]
+    for i in range(nd):
+        perm.extend([nd + 1 + i, i])
+    perm.extend(range(1 + 2 * nd, x.ndim))
+    x = x.transpose(perm)
+    newsp = tuple(spatial[i] * int(block[i]) for i in range(nd))
+    x = x.reshape((B // nb,) + newsp + rest)
+    sl = [slice(None)]
+    for i in range(nd):
+        c0, c1 = int(crops[i][0]), int(crops[i][1])
+        sl.append(slice(c0, newsp[i] - c1))
+    return x[tuple(sl)]
+
+
+@tf_op("SpaceToBatchND")
+def _space_to_batch(node, xs):
+    x, block, pads = xs[0], np.asarray(xs[1]).ravel(), np.asarray(xs[2])
+    nd = len(block)
+    pad_spec = [(0, 0)] + [tuple(int(v) for v in p) for p in pads] \
+        + [(0, 0)] * (x.ndim - 1 - nd)
+    x = jnp.pad(x, pad_spec)
+    B = x.shape[0]
+    spatial = x.shape[1:1 + nd]
+    rest = x.shape[1 + nd:]
+    shape = (B,)
+    for i in range(nd):
+        shape += (spatial[i] // int(block[i]), int(block[i]))
+    shape += rest
+    x = x.reshape(shape)
+    perm = []
+    for i in range(nd):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(nd):
+        perm.append(1 + 2 * i)
+    perm.extend(range(1 + 2 * nd, x.ndim))
+    x = x.transpose(perm)
+    return x.reshape((B * int(np.prod(block)),)
+                     + tuple(spatial[i] // int(block[i]) for i in range(nd))
+                     + rest)
+
+
 # ------------------------------------------------------------- the importer
+
+
+# deadness sentinel for TF1 control flow: Switch kills one branch, Merge
+# revives the surviving one; every other op propagates deadness (the same
+# semantics the TF executor implements with "dead" tensors)
+DEAD = object()
+
+# output-arg name -> tuple position, for function-body refs "node:arg:idx".
+# Ops with ONE (possibly list-typed) output arg resolve by idx alone.
+_MULTI_OUT_ARGS = {
+    "Switch": ["output_false", "output_true"],
+    "Merge": ["output", "value_index"],
+    "TopKV2": ["values", "indices"],
+    "FusedBatchNorm": ["y", "batch_mean", "batch_variance",
+                       "reserve_space_1", "reserve_space_2"],
+    "FusedBatchNormV3": ["y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2",
+                         "reserve_space_3"],
+}
+
+_CONTROL_OPS = ("Switch", "Merge", "If", "StatelessIf", "While",
+                "StatelessWhile", "PartitionedCall",
+                "StatefulPartitionedCall")
 
 
 class TFImportedGraph:
     """Executable imported graph: call .output(feeds) or use .as_function()."""
 
-    def __init__(self, nodes: List[NodeDef]):
+    def __init__(self, nodes: List[NodeDef],
+                 functions: Optional[Dict[str, "TFFunction"]] = None):
         self.nodes = {n.name: n for n in nodes}
         self.order = [n.name for n in nodes]  # GraphDefs are topo-sorted
+        self.functions = functions or {}
         self.constants: Dict[str, np.ndarray] = {}
         self.placeholders: List[str] = []
         for n in nodes:
@@ -560,6 +958,122 @@ class TFImportedGraph:
         name = name.split(":")[0]
         return name[1:] if name.startswith("^") else name
 
+    def _resolve(self, acts, ref, op_of: Dict[str, str]):
+        """Resolve an input ref — "name", "name:N" (graph style) or
+        "name:out_arg:N" (function-body style) — against produced values."""
+        parts = ref.split(":")
+        name = parts[0]
+        v = acts[name]
+        if not isinstance(v, tuple):
+            return v
+        if len(parts) == 1:
+            return v[0]
+        if len(parts) == 2:
+            return v[int(parts[1])]
+        arg, idx = parts[1], int(parts[2])
+        args = _MULTI_OUT_ARGS.get(op_of.get(name, ""), None)
+        if args and arg in args:
+            return v[args.index(arg) + idx]
+        return v[idx]  # single (list-typed) output arg: idx indexes the list
+
+    def _call_function(self, fname: str, args: list):
+        fn = self.functions.get(fname)
+        if fn is None:
+            raise NotImplementedError(
+                f"graph references function '{fname}' but the GraphDef "
+                f"library does not define it")
+        env = dict(zip(fn.in_args, args))
+        self._exec_nodes(fn.nodes, env)
+        outs = [self._resolve(env, fn.ret.get(o, o),
+                              {n.name: n.op for n in fn.nodes})
+                for o in fn.out_args]
+        return outs
+
+    def _exec_nodes(self, nodes, acts):
+        """The topological node loop (shared by the main graph and function
+        bodies). Mutates ``acts``."""
+        op_of = {n.name: n.op for n in nodes}
+        op_of.update({k: n.op for k, n in self.nodes.items()})
+        for node in nodes:
+            name = node.name
+            if node.op == "Const":
+                acts[name] = node.attr("value").tensor
+                continue
+            if node.op in ("Placeholder", "Arg", "_Arg"):
+                continue  # fed externally
+            if node.op in ("_Retval", "NoOp"):
+                if node.op == "_Retval" and node.inputs:
+                    acts[name] = self._resolve(acts, node.inputs[0], op_of)
+                continue
+            ins = [i for i in node.inputs if not i.startswith("^")]
+            xs = [self._resolve(acts, i, op_of) for i in ins]
+            # deadness propagation (Merge alone consumes dead inputs)
+            if node.op != "Merge" and any(x is DEAD for x in xs):
+                acts[name] = DEAD
+                continue
+            if node.op in _CONTROL_OPS:
+                acts[name] = self._exec_control(node, xs)
+                continue
+            fn = TF_OP_REGISTRY.get(node.op)
+            if fn is None:
+                raise NotImplementedError(
+                    f"TF op '{node.op}' (node {name}) has no mapper; "
+                    f"register one with @tf_op('{node.op}')")
+            acts[name] = fn(node, xs)
+
+    def _exec_control(self, node, xs):
+        op = node.op
+        if op == "Switch":
+            data, pred = xs
+            try:
+                alive = bool(np.asarray(pred))
+            except Exception as e:  # traced predicate
+                raise NotImplementedError(
+                    "Switch with a non-concrete predicate cannot execute "
+                    "eagerly; TF2 If/While (function-based) control flow "
+                    "supports tracing") from e
+            return (DEAD, data) if alive else (data, DEAD)
+        if op == "Merge":
+            idx = next((i for i, x in enumerate(xs) if x is not DEAD), None)
+            if idx is None:  # fully-dead Merge outputs dead (TF semantics)
+                return (DEAD, DEAD)
+            return (xs[idx], np.asarray(idx, np.int32))
+        if op in ("If", "StatelessIf"):
+            pred, args = xs[0], xs[1:]
+            tb = node.attr("then_branch").func_name
+            fb = node.attr("else_branch").func_name
+            try:
+                alive = bool(np.asarray(pred))
+                outs = self._call_function(tb if alive else fb, args)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError):
+                outs = jax.lax.cond(
+                    jnp.asarray(pred).reshape(()),
+                    lambda a: tuple(jnp.asarray(v) for v in
+                                    self._call_function(tb, list(a))),
+                    lambda a: tuple(jnp.asarray(v) for v in
+                                    self._call_function(fb, list(a))),
+                    tuple(args))
+                outs = list(outs)
+            return tuple(outs)
+        if op in ("While", "StatelessWhile"):
+            cond_f = node.attr("cond").func_name
+            body_f = node.attr("body").func_name
+
+            def cond_w(carry):
+                out = self._call_function(cond_f, list(carry))
+                return jnp.asarray(out[0]).reshape(()).astype(bool)
+
+            def body_w(carry):
+                return tuple(jnp.asarray(v)
+                             for v in self._call_function(body_f, list(carry)))
+
+            carry = tuple(jnp.asarray(x) for x in xs)
+            return jax.lax.while_loop(cond_w, body_w, carry)
+        # PartitionedCall / StatefulPartitionedCall
+        f = node.attr("f").func_name
+        return tuple(self._call_function(f, xs))
+
     def output(self, feeds: Dict[str, np.ndarray],
                outputs: Optional[List[str]] = None):
         """Execute the graph (InferenceSession.output analog)."""
@@ -572,21 +1086,12 @@ class TFImportedGraph:
             acts[name] = const
         for name, val in feeds.items():
             acts[name] = jnp.asarray(val)
-        for name in self.order:
-            node = self.nodes[name]
-            if node.op in ("Const", "Placeholder"):
-                continue
-            fn = TF_OP_REGISTRY.get(node.op)
-            if fn is None:
-                raise NotImplementedError(
-                    f"TF op '{node.op}' (node {name}) has no mapper; "
-                    f"register one with @tf_op('{node.op}')")
-            xs = [acts[self._ref(i)] for i in node.inputs
-                  if not i.startswith("^")]
-            acts[name] = fn(node, xs)
+        self._exec_nodes([self.nodes[n] for n in self.order
+                          if not (self.nodes[n].op == "Const")], acts)
         if outputs is None:
             outputs = [self.order[-1]]
-        res = [acts[self._ref(o)] for o in outputs]
+        op_of = {k: n.op for k, n in self.nodes.items()}
+        res = [self._resolve(acts, o, op_of) for o in outputs]
         return res[0] if len(res) == 1 else res
 
     def as_function(self, outputs: Optional[List[str]] = None) -> Callable:
@@ -717,4 +1222,5 @@ class TFGraphMapper:
         else:
             with open(path_or_bytes, "rb") as f:
                 buf = f.read()
-        return TFImportedGraph(parse_graph_def(buf))
+        nodes, functions = parse_graph(buf)
+        return TFImportedGraph(nodes, functions)

@@ -1,0 +1,150 @@
+"""Real-framework golden-fixture import tests (VERDICT r1 #4).
+
+The SURVEY §4 "TFGraphTestAllSameDiff" pattern: graphs produced by ACTUAL
+framework tooling (TensorFlow's convert_variables_to_constants_v2, torch's
+onnx exporter) are committed as binary fixtures together with recorded
+inputs and per-node intermediate outputs; the importer must reproduce every
+recorded intermediate — no TF/torch needed at test time.
+
+Fixture provenance (regeneration requires tensorflow / torch+transformers):
+- tf_small_cnn.pb + _golden.npz: real keras CNN (conv/bn/depthwise/pool/
+  dense), frozen by TF 2.21, intermediates recorded via a v1 session.
+- bert_tiny.onnx + bert_golden.npz: transformers BertModel (2 layers,
+  hidden 64) exported by torch.onnx.export (opset 14), outputs recorded
+  from the torch module in eval mode.
+- ctrl_flow_v2.pb + ctrl_golden.npz: tf.cond + tf.while_loop frozen with
+  lower_control_flow=False (functional StatelessIf/StatelessWhile + the
+  GraphDef function library).
+- switch_merge.pb + switch_golden.npz: TF1 raw Switch/Merge graph.
+
+The live test at the bottom regenerates ResNet50 from keras.applications
+when TF is importable, checking 53 intermediates + logits at 1e-4.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+class TestTFGoldenFixtures:
+    def test_small_cnn_node_by_node(self):
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("tf_small_cnn_golden.npz"))
+        imp = TFGraphMapper.import_graph(_fx("tf_small_cnn.pb"))
+        probe = [str(p) for p in g["probe"]]
+        outs = imp.output({str(g["placeholder"]): g["x"]}, outputs=probe)
+        worst = 0.0
+        for i, (name, got) in enumerate(zip(probe, outs)):
+            want = g[f"node_{i}"]
+            err = float(np.max(np.abs(np.asarray(got) - want)))
+            scale = float(np.max(np.abs(want))) + 1e-9
+            assert err / scale < 1e-4, (
+                f"node {name}: rel err {err / scale:.2e}")
+            worst = max(worst, err / scale)
+        assert worst < 1e-4
+
+    def test_functional_control_flow(self):
+        """StatelessIf + StatelessWhile through the GraphDef function
+        library — both branch outcomes."""
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("ctrl_golden.npz"))
+        imp = TFGraphMapper.import_graph(_fx("ctrl_flow_v2.pb"))
+        assert imp.functions, "function library was not parsed"
+        ph = imp.placeholders[0]
+        for sign, want in [(1, g["want_pos"]), (-1, g["want_neg"])]:
+            out = np.asarray(imp.output({ph: sign * np.abs(g["x"])}))
+            np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    def test_tf1_switch_merge(self):
+        """Raw TF1 Switch/Merge with deadness propagation."""
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("switch_golden.npz"))
+        imp = TFGraphMapper.import_graph(_fx("switch_merge.pb"))
+        out = np.asarray(imp.output({"x": g["x"]}, outputs=["out"]))
+        np.testing.assert_allclose(out, g["want"], rtol=1e-6, atol=1e-6)
+
+
+class TestOnnxGoldenFixtures:
+    def test_bert_tiny_outputs(self):
+        """Real torch-exported BERT: both outputs at 1e-4 vs the recorded
+        torch eval-mode forward."""
+        from deeplearning4j_tpu.modelimport.onnx import OnnxModelImport
+
+        g = np.load(_fx("bert_golden.npz"))
+        imp = OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+        lh, po = imp.output(
+            {"input_ids": g["ids"], "attention_mask": g["mask"]},
+            outputs=["last_hidden_state", "pooler_output"])
+        np.testing.assert_allclose(np.asarray(lh), g["last_hidden"],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(po), g["pooler"],
+                                   rtol=1e-4, atol=1e-4)
+        assert np.asarray(po).shape == g["pooler"].shape  # rank-0 Gather index
+
+
+def _tf_available():
+    try:
+        import tensorflow  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _tf_available(), reason="tensorflow not installed")
+class TestLiveResNet50:
+    """Regenerates a REAL keras.applications.ResNet50 frozen graph and
+    checks logits + every Relu/MaxPool/Mean/MatMul intermediate against a
+    live TF v1 session. Heavy (~2 min) but the strongest parity statement:
+    nothing in this graph was synthesized by this repo."""
+
+    def test_resnet50_import_parity(self, tmp_path):
+        import tensorflow as tf
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+
+        tf.random.set_seed(0)
+        m = tf.keras.applications.ResNet50(weights=None,
+                                           input_shape=(224, 224, 3))
+        f = tf.function(lambda x: m(x, training=False))
+        cf = f.get_concrete_function(
+            tf.TensorSpec([1, 224, 224, 3], tf.float32))
+        frozen = convert_variables_to_constants_v2(cf)
+        gd = frozen.graph.as_graph_def()
+        pb = str(tmp_path / "resnet50.pb")
+        with open(pb, "wb") as fh:
+            fh.write(gd.SerializeToString())
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 224, 224, 3)).astype(np.float32)
+        ph = [n.name for n in gd.node if n.op == "Placeholder"][0]
+        probe = [n.name for n in gd.node
+                 if n.op in ("Relu", "MaxPool", "Mean", "MatMul", "Softmax")]
+
+        import tensorflow.compat.v1 as tf1
+        g1 = tf1.Graph()
+        with g1.as_default():
+            tf1.import_graph_def(gd, name="")
+        with tf1.Session(graph=g1) as sess:
+            tf_outs = sess.run([f"{n}:0" for n in probe], {f"{ph}:0": x})
+
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        imp = TFGraphMapper.import_graph(pb)
+        ours = imp.output({ph: x}, outputs=probe)
+        for name, want, got in zip(probe, tf_outs, ours):
+            err = float(np.max(np.abs(want - np.asarray(got))))
+            scale = float(np.max(np.abs(want))) + 1e-9
+            assert err / scale < 1e-4, f"{name}: rel err {err / scale:.2e}"
+        # the final softmax IS the last probe entry: logits at 1e-4 absolute
+        np.testing.assert_allclose(np.asarray(ours[-1]), tf_outs[-1],
+                                   atol=1e-4)
