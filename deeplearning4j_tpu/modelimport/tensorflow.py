@@ -1045,7 +1045,8 @@ class TFImportedGraph:
             try:
                 alive = bool(np.asarray(pred))
                 outs = self._call_function(tb if alive else fb, args)
-            except (jax.errors.TracerBoolConversionError,
+            except (jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError):
                 outs = jax.lax.cond(
                     jnp.asarray(pred).reshape(()),

@@ -148,3 +148,23 @@ class TestLiveResNet50:
         # the final softmax IS the last probe entry: logits at 1e-4 absolute
         np.testing.assert_allclose(np.asarray(ours[-1]), tf_outs[-1],
                                    atol=1e-4)
+
+
+class TestTracedControlFlow:
+    def test_functional_graph_jits(self):
+        """The imported functional-control-flow graph must also work UNDER
+        jit (traced predicate -> lax.cond, While -> lax.while_loop)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("ctrl_golden.npz"))
+        imp = TFGraphMapper.import_graph(_fx("ctrl_flow_v2.pb"))
+        ph = imp.placeholders[0]
+        f = jax.jit(lambda x: imp.output({ph: x}))
+        out = np.asarray(f(jnp.asarray(np.abs(g["x"]))))
+        np.testing.assert_allclose(out, g["want_pos"], rtol=1e-5, atol=1e-5)
+        out_neg = np.asarray(f(jnp.asarray(-np.abs(g["x"]))))
+        np.testing.assert_allclose(out_neg, g["want_neg"], rtol=1e-5,
+                                   atol=1e-5)
