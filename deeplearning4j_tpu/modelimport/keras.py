@@ -43,6 +43,28 @@ _KERAS_ACT = {
 }
 
 
+def read_h5_layer_arrays(h5file, layer_name):
+    """One keras layer's weight arrays, in keras order, from a legacy
+    whole-model h5 (the single shared decoder for all import paths)."""
+    wg = h5file["model_weights"]
+    if layer_name not in wg:
+        return []
+    g = wg[layer_name]
+    names = [n.decode() if isinstance(n, bytes) else n
+             for n in g.attrs.get("weight_names", [])]
+    return [np.asarray(g[n]) for n in names]
+
+
+def h5_layer_order(h5file):
+    """Keras layer names in CREATION order (the h5 layer_names attr; h5
+    groups themselves iterate alphabetically, which interleaves types)."""
+    wg = h5file["model_weights"]
+    names = wg.attrs.get("layer_names")
+    if names is None:
+        return list(wg)
+    return [n.decode() if isinstance(n, bytes) else n for n in names]
+
+
 def _pad(cfg):
     return "same" if cfg.get("padding", "valid") == "same" else "valid"
 
@@ -326,15 +348,8 @@ class KerasModelImport:
     def _load_weights_graph(model, f):
         from deeplearning4j_tpu.nn.conf.graph import LayerVertex
 
-        wg = f["model_weights"]
-
         def arrays_for(name):
-            if name not in wg:
-                return []
-            g = wg[name]
-            names = [n.decode() if isinstance(n, bytes) else n
-                     for n in g.attrs.get("weight_names", [])]
-            return [np.asarray(g[n]) for n in names]
+            return read_h5_layer_arrays(f, name)
 
         for name, vertex in model.conf.vertices.items():
             if not isinstance(vertex, LayerVertex):
@@ -349,15 +364,8 @@ class KerasModelImport:
     # -------------------------------------------------------------- weights
     @staticmethod
     def _load_weights(model: MultiLayerNetwork, f, cfg: dict):
-        wg = f["model_weights"]
-
         def arrays_for(name):
-            if name not in wg:
-                return []
-            g = wg[name]
-            names = [n.decode() if isinstance(n, bytes) else n
-                     for n in g.attrs.get("weight_names", [])]
-            return [np.asarray(g[n]) for n in names]
+            return read_h5_layer_arrays(f, name)
 
         for li, (layer, kname) in enumerate(zip(model.layers, model._keras_names)):
             ws = arrays_for(kname)

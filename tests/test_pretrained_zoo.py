@@ -1,0 +1,172 @@
+"""init_pretrained round trips (VERDICT r1 #5): real-framework weights ->
+converter -> zip artifact -> ZooModel.init_pretrained -> prediction parity
+against the source framework.
+
+The keras tests regenerate canonical keras.applications architectures with
+random (seeded) weights — the weight LAYOUT conversion is what is under
+test, and it is identical for trained weights. The ONNX test exports a
+VGG-style torch module, exercising OIHW->HWIO, Gemm [out,in]->[in,out] and
+the C,H,W->H,W,C first-dense permutation (the NCHW->NHWC pitfall).
+"""
+
+import numpy as np
+import pytest
+
+
+def _tf():
+    try:
+        import tensorflow
+        return tensorflow
+    except Exception:
+        return None
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except Exception:
+        return None
+
+
+@pytest.mark.skipif(_tf() is None, reason="tensorflow not installed")
+class TestKerasPretrained:
+    def test_vgg16_round_trip(self, tmp_path):
+        import tensorflow as tf
+
+        from deeplearning4j_tpu.zoo import VGG16
+        from deeplearning4j_tpu.zoo.pretrained import (keras_h5_to_zoo,
+                                                       save_pretrained)
+
+        tf.random.set_seed(1)
+        km = tf.keras.applications.VGG16(weights=None,
+                                         input_shape=(224, 224, 3))
+        h5 = str(tmp_path / "vgg16.h5")
+        km.save(h5)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 224, 224, 3)).astype(np.float32)
+        want = km(x, training=False).numpy()
+
+        m = keras_h5_to_zoo(h5, VGG16().init())
+        artifact = str(tmp_path / "vgg16_zoo.zip")
+        save_pretrained(m, artifact)
+        m2 = VGG16().init_pretrained(artifact)
+        got = np.asarray(m2.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_resnet50_round_trip(self, tmp_path):
+        import tensorflow as tf
+
+        from deeplearning4j_tpu.zoo import ResNet50
+        from deeplearning4j_tpu.zoo.pretrained import (keras_h5_to_zoo,
+                                                       resnet50_keras_map,
+                                                       save_pretrained)
+
+        tf.random.set_seed(2)
+        km = tf.keras.applications.ResNet50(weights=None,
+                                            input_shape=(224, 224, 3))
+        h5 = str(tmp_path / "resnet50.h5")
+        km.save(h5)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 224, 224, 3)).astype(np.float32)
+        want = km(x, training=False).numpy()
+
+        m = keras_h5_to_zoo(h5, ResNet50(dtype="float32").init(),
+                            name_map=resnet50_keras_map())
+        artifact = str(tmp_path / "resnet50_zoo.zip")
+        save_pretrained(m, artifact)
+        m2 = ResNet50(dtype="float32").init_pretrained(artifact)
+        got = np.asarray(m2.output(x))
+        # 50 layers of f32 conv accumulation-order differences
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_mismatched_architecture_rejected(self, tmp_path):
+        import tensorflow as tf
+
+        from deeplearning4j_tpu.zoo import LeNet
+        from deeplearning4j_tpu.zoo.pretrained import keras_h5_to_zoo
+
+        km = tf.keras.Sequential([
+            tf.keras.layers.Conv2D(4, 3, input_shape=(28, 28, 1)),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(10),
+        ])
+        h5 = str(tmp_path / "tiny.h5")
+        km.save(h5)
+        with pytest.raises(ValueError, match="do not align"):
+            keras_h5_to_zoo(h5, LeNet().init())
+
+
+@pytest.mark.skipif(_torch() is None, reason="torch not installed")
+class TestOnnxPretrained:
+    def test_torch_cnn_layout_conversion(self, tmp_path, monkeypatch):
+        """Small VGG-style torch export: OIHW conv kernels, transB Gemm and
+        the flatten-order permutation must all be converted."""
+        import importlib.machinery
+        import sys
+        import types
+
+        if "onnx" not in sys.modules:  # torch's exporter only scans for
+            stub = types.ModuleType("onnx")  # onnxscript functions via onnx
+            stub.__spec__ = importlib.machinery.ModuleSpec("onnx", loader=None)
+            stub.__version__ = "1.16.0"
+
+            class _G:
+                node = []
+
+            class _M:
+                graph = _G()
+                functions = []
+
+                def SerializeToString(self):
+                    return b""
+
+            stub.load_model_from_string = lambda b: _M()
+            monkeypatch.setitem(sys.modules, "onnx", stub)
+
+        import torch
+        import torch.nn as nn
+
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  DenseLayer, OutputLayer,
+                                                  SubsamplingLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.zoo.pretrained import onnx_to_zoo
+
+        torch.manual_seed(0)
+        tm = nn.Sequential(
+            nn.Conv2d(3, 8, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Conv2d(8, 16, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Flatten(),
+            nn.Linear(16 * 4 * 4, 32), nn.ReLU(),
+            nn.Linear(32, 5),
+        ).eval()
+        x = torch.randn(2, 3, 16, 16)
+        path = str(tmp_path / "cnn.onnx")
+        torch.onnx.export(tm, (x,), path, input_names=["input"],
+                          output_names=["logits"], opset_version=14,
+                          dynamo=False)
+        with torch.no_grad():
+            logits = tm(x).numpy()
+
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                        padding="same", activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), strides=(2, 2),
+                                        pooling_type="max"))
+                .layer(ConvolutionLayer(n_out=16, kernel=(3, 3),
+                                        padding="same", activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), strides=(2, 2),
+                                        pooling_type="max"))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=5, activation="identity",
+                                   loss="mse"))
+                .set_input_type(InputType.convolutional(16, 16, 3)).build())
+        m = MultiLayerNetwork(conf).init()
+        onnx_to_zoo(path, m)
+        got = np.asarray(m.output(np.transpose(x.numpy(), (0, 2, 3, 1))))
+        np.testing.assert_allclose(got, logits, atol=1e-5)
