@@ -348,13 +348,10 @@ class KerasModelImport:
     def _load_weights_graph(model, f):
         from deeplearning4j_tpu.nn.conf.graph import LayerVertex
 
-        def arrays_for(name):
-            return read_h5_layer_arrays(f, name)
-
         for name, vertex in model.conf.vertices.items():
             if not isinstance(vertex, LayerVertex):
                 continue
-            ws = arrays_for(name)
+            ws = read_h5_layer_arrays(f, name)
             if not ws:
                 continue
             KerasModelImport._copy_layer_weights(
@@ -364,11 +361,8 @@ class KerasModelImport:
     # -------------------------------------------------------------- weights
     @staticmethod
     def _load_weights(model: MultiLayerNetwork, f, cfg: dict):
-        def arrays_for(name):
-            return read_h5_layer_arrays(f, name)
-
         for li, (layer, kname) in enumerate(zip(model.layers, model._keras_names)):
-            ws = arrays_for(kname)
+            ws = read_h5_layer_arrays(f, kname)
             if not ws:
                 continue
             KerasModelImport._copy_layer_weights(

@@ -1,4 +1,4 @@
-"""Fused LSTM recurrence — single-kernel sequence loop.
+"""Fused LSTM recurrence — single-kernel sequence loop, tiled over hidden.
 
 Reference analog: CudnnLSTMHelper (deeplearning4j-cuda ::
 org.deeplearning4j.nn.layers.recurrent.CudnnLSTMHelper), which replaces the
@@ -6,11 +6,20 @@ per-timestep Java loop with one cuDNN persistent-RNN launch. Same split
 here: the [B*T, F]x[F,4H] input projection is left to XLA (it is a single
 MXU-shaped matmul); the irreducibly-sequential part — T iterations of
 h@R + gate elementwise — runs inside ONE Pallas kernel with h/c resident in
-VMEM scratch and R pinned in VMEM, so the recurrence never round-trips HBM
-per step (the reason cuDNN's persistent kernels win).
+VMEM scratch, so the recurrence never round-trips HBM per step (the reason
+cuDNN's persistent kernels win).
 
-Grid: (T,) sequential; xg block [B, 4H] per step; gate order IFOG matching
-ops/recurrent.lstm_layer.
+Tiling: grid (T, H/Hb), hidden-tile innermost. Each (t, j) step computes
+gate columns for hidden slice j from the FULL previous h (double-buffered
+in scratch: h_prev is stable while h_next accumulates tiles, swapped after
+the last tile of each timestep), so R never needs to fit VMEM whole —
+R is pre-laid-out as [nH, H, 4*Hb] per-tile panels. The tile size is chosen
+by a VMEM budget (lstm_tile), which is also the selection predicate: big
+models (H=1024, B=256+) now use the kernel instead of silently falling back.
+
+GravesLSTM peepholes (i,f from c_{t-1}; o from c_t — DL4J semantics,
+matching ops/recurrent.lstm_layer) are fused in the same kernel; gate order
+IFOG throughout.
 """
 
 from __future__ import annotations
@@ -25,29 +34,48 @@ from jax.experimental.pallas import tpu as pltpu
 from deeplearning4j_tpu.ops.registry import register_impl
 
 
-def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, out_ref, hT_ref, cT_ref,
-                 h_scr, c_scr, *, hidden):
+def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, p_ref, out_ref, hT_ref,
+                 cT_ref, hprev_scr, hnext_scr, c_scr, *, hb, has_peephole):
     t = pl.program_id(0)
+    j = pl.program_id(1)
     nt = pl.num_programs(0)
-    H = hidden
+    nj = pl.num_programs(1)
 
-    @pl.when(t == 0)
+    @pl.when((t == 0) & (j == 0))
     def _init():
-        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        hprev_scr[:] = h0_ref[:].astype(jnp.float32)
         c_scr[:] = c0_ref[:].astype(jnp.float32)
 
-    g = xg_ref[0].astype(jnp.float32) + jax.lax.dot_general(
-        h_scr[:], r_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)            # [B, 4H]
-    i = jax.nn.sigmoid(g[:, :H])
-    f = jax.nn.sigmoid(g[:, H:2 * H])
-    o = jax.nn.sigmoid(g[:, 2 * H:3 * H])
-    z = jnp.tanh(g[:, 3 * H:])
-    c_new = f * c_scr[:] + i * z
+    cols = (slice(None), pl.ds(j * hb, hb))
+    # gates for hidden slice j from the FULL previous h (double buffer)
+    g = xg_ref[0, 0].astype(jnp.float32) + jax.lax.dot_general(
+        hprev_scr[:].astype(r_ref.dtype), r_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [B, 4*hb]
+    gi = g[:, :hb]
+    gf = g[:, hb:2 * hb]
+    go = g[:, 2 * hb:3 * hb]
+    gz = g[:, 3 * hb:]
+    c_old = c_scr[cols]
+    if has_peephole:
+        p = p_ref[0].astype(jnp.float32)               # [3, hb]
+        gi = gi + c_old * p[0:1, :]
+        gf = gf + c_old * p[1:2, :]
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf)
+    z = jnp.tanh(gz)
+    c_new = f * c_old + i * z
+    if has_peephole:
+        go = go + c_new * p[2:3, :]
+    o = jax.nn.sigmoid(go)
     h_new = o * jnp.tanh(c_new)
-    c_scr[:] = c_new
-    h_scr[:] = h_new
+    c_scr[cols] = c_new
+    hnext_scr[cols] = h_new
     out_ref[0] = h_new.astype(out_ref.dtype)
+
+    @pl.when(j == nj - 1)
+    def _advance():
+        hprev_scr[:] = hnext_scr[:]
 
     @pl.when(t == nt - 1)
     def _final():
@@ -55,42 +83,83 @@ def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, out_ref, hT_ref, cT_ref,
         cT_ref[:] = c_new.astype(cT_ref.dtype)
 
 
-def _fused_recurrence(xg, R, h0, c0, *, interpret):
+def lstm_tile(B, H, T, rdtype_bytes=4, budget=13 << 20):
+    """Largest hidden tile (multiple of 128, dividing H) whose working set
+    fits the VMEM budget; None when even Hb=128 does not fit (fall back).
+
+    Grid-VARYING blocks (R/xg/peephole panels indexed by t or j, and the
+    out/hT/cT tiles) are double-buffered by the Pallas pipeline, so they
+    count twice; the grid-invariant h0/c0 blocks and the three scratch
+    buffers count once. Budget is set under the ~16M scoped-VMEM limit."""
+    for hb in (H, 1024, 512, 256, 128):
+        if hb > H or H % hb:
+            continue
+        est = (2 * H * 4 * hb * rdtype_bytes   # R panel (dbl-buffered)
+               + 2 * B * 4 * hb * 4            # xg block (dbl-buffered)
+               + 2 * 3 * B * hb * 4            # out/hT/cT tiles (dbl)
+               + 3 * B * H * 4                 # h double buffer + c scratch
+               + 2 * B * H * 4)                # h0 + c0 (invariant)
+        if est <= budget:
+            return hb
+    return None
+
+
+def _fused_recurrence(xg, R, h0, c0, peephole, *, interpret):
     """xg [T, B, 4H] time-major pre-projected gates; returns
     (outputs [T, B, H], hT, cT)."""
     T, B, G = xg.shape
     H = G // 4
+    hb = lstm_tile(B, H, T, rdtype_bytes=R.dtype.itemsize)
+    if hb is None:
+        raise ValueError(f"no VMEM-feasible LSTM tile for B={B}, H={H}")
+    nj = H // hb
+    # per-tile panels: R [nH, H, 4*Hb]; xg [T, nH, B, 4*Hb]
+    Rl = R.reshape(H, 4, nj, hb).transpose(2, 0, 1, 3).reshape(nj, H, 4 * hb)
+    xgl = (xg.reshape(T, B, 4, nj, hb).transpose(0, 3, 1, 2, 4)
+           .reshape(T, nj, B, 4 * hb))
+    has_p = peephole is not None
+    if has_p:
+        pll = peephole.reshape(3, nj, hb).transpose(1, 0, 2)  # [nH, 3, hb]
+    else:
+        pll = jnp.zeros((nj, 3, hb), xg.dtype)
+
     out, hT, cT = pl.pallas_call(
-        functools.partial(_lstm_kernel, hidden=H),
+        functools.partial(_lstm_kernel, hb=hb, has_peephole=has_p),
         out_shape=(jax.ShapeDtypeStruct((T, B, H), xg.dtype),
                    jax.ShapeDtypeStruct((B, H), xg.dtype),
                    jax.ShapeDtypeStruct((B, H), xg.dtype)),
-        grid=(T,),
+        grid=(T, nj),
         in_specs=[
-            pl.BlockSpec((1, B, G), lambda t: (t, 0, 0),
+            pl.BlockSpec((1, 1, B, 4 * hb), lambda t, j: (t, j, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((H, G), lambda t: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H, 4 * hb), lambda t, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3, hb), lambda t, j: (j, 0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+            pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
         ],
         interpret=interpret,
-    )(xg, R, h0, c0)
+    )(xgl, Rl, h0, c0, pll)
     return out, hT, cT
 
 
-def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
-                     forget_gate_bias=0.0, reverse=False):
-    """Drop-in accelerated impl of the "lstm_layer" op (same signature)."""
+def _kernel_forward(x, h0, c0, W, R, b, peephole, forget_gate_bias, reverse):
     H = R.shape[0]
     xg = x @ W + b
     if forget_gate_bias:
@@ -99,19 +168,69 @@ def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
     if reverse:
         xg = jnp.flip(xg, axis=0)
     interpret = jax.default_backend() != "tpu"
-    out, hT, cT = _fused_recurrence(xg, R, h0, c0, interpret=interpret)
+    out, hT, cT = _fused_recurrence(xg, R, h0, c0, peephole,
+                                    interpret=interpret)
     if reverse:
         out = jnp.flip(out, axis=0)
     return jnp.swapaxes(out, 0, 1), (hT, cT)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _fused(x, h0, c0, W, R, b, peephole, forget_gate_bias, reverse):
+    return _kernel_forward(x, h0, c0, W, R, b, peephole, forget_gate_bias,
+                           reverse)
+
+
+def _fused_fwd(x, h0, c0, W, R, b, peephole, forget_gate_bias, reverse):
+    out = _kernel_forward(x, h0, c0, W, R, b, peephole, forget_gate_bias,
+                          reverse)
+    return out, (x, h0, c0, W, R, b, peephole)
+
+
+def _fused_bwd(forget_gate_bias, reverse, res, g):
+    # backward recomputes through the XLA scan lowering: the recurrence
+    # gradient is itself a reverse-time scan, which XLA compiles well; a
+    # dedicated Pallas backward kernel is the remaining cuDNN-parity gap
+    from deeplearning4j_tpu.ops.recurrent import lstm_layer
+
+    x, h0, c0, W, R, b, peephole = res
+    diff_args = (x, h0, c0, W, R, b) + (() if peephole is None else (peephole,))
+
+    def ref(*args):
+        if peephole is None:
+            xx, hh, cc, WW, RR, bb = args
+            pp = None
+        else:
+            xx, hh, cc, WW, RR, bb, pp = args
+        return lstm_layer(xx, hh, cc, WW, RR, bb, peephole=pp,
+                          forget_gate_bias=forget_gate_bias, reverse=reverse)
+
+    _, vjp = jax.vjp(ref, *diff_args)
+    grads = vjp(g)
+    if peephole is None:
+        grads = grads + (None,)
+    return grads
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
+                     forget_gate_bias=0.0, reverse=False):
+    """Drop-in accelerated impl of the "lstm_layer" op (same signature)."""
+    return _fused(x, h0, c0, W, R, b, peephole, float(forget_gate_bias),
+                  bool(reverse))
+
+
 def _lstm_requires(x, h0, c0, W, R, b, *, peephole=None, **kw):
-    # structural: the kernel has no peephole terms (GravesLSTM stays on scan)
-    return peephole is None
+    # structural: a VMEM-feasible tile must exist
+    H = R.shape[0]
+    return lstm_tile(x.shape[0], H, x.shape[1],
+                     rdtype_bytes=R.dtype.itemsize) is not None
 
 
 def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
-    # perf heuristic: lane-aligned hidden size, batch fits a VMEM tile
+    # perf heuristic: lane-aligned hidden size, sublane-aligned batch
     H = R.shape[0]
     return H % 128 == 0 and x.shape[0] % 8 == 0
 

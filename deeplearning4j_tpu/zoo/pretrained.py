@@ -147,9 +147,9 @@ def onnx_to_zoo(onnx_path: str, model,
                     break
         return ws
 
-    weighted = [n for n in imp.nodes
-                if n.op in ("Conv", "Gemm", "BatchNormalization", "MatMul")
-                and node_ws(n)]
+    weighted = [(n, node_ws(n)) for n in imp.nodes
+                if n.op in ("Conv", "Gemm", "BatchNormalization", "MatMul")]
+    weighted = [(n, ws) for n, ws in weighted if ws]
     ours = [(i, l) for i, l in enumerate(model.layers) if model.params[i]]
     if len(weighted) != len(ours):
         raise ValueError(
@@ -160,14 +160,16 @@ def onnx_to_zoo(onnx_path: str, model,
         flatten_spatial = _infer_flatten_spatial(model)
 
     seen_dense = False
-    for node, (i, layer) in zip(weighted, ours):
+    for (node, ws), (i, layer) in zip(weighted, ours):
         p = model.params[i]
-        ws = node_ws(node)
         if node.op == "Conv":
             if not isinstance(layer, ConvolutionLayer):
                 raise ValueError(f"layer {i} is not a conv")
             p["W"] = jnp.asarray(np.transpose(ws[0], (2, 3, 1, 0)))  # OIHW->HWIO
-            if len(ws) > 1 and "b" in p:
+            if len(ws) > 1:
+                if "b" not in p:
+                    raise ValueError(f"conv layer {i} has no bias param but "
+                                     f"the ONNX node carries one")
                 p["b"] = jnp.asarray(ws[1])
         elif node.op == "BatchNormalization":
             if not isinstance(layer, BatchNormalizationLayer):
@@ -192,7 +194,10 @@ def onnx_to_zoo(onnx_path: str, model,
                          .reshape(H * Wd * C, -1))
                 seen_dense = True
             p["W"] = jnp.asarray(W)
-            if len(ws) > 1 and "b" in p:
+            if len(ws) > 1:
+                if "b" not in p:
+                    raise ValueError(f"dense layer {i} has no bias param but "
+                                     f"the ONNX node carries one")
                 p["b"] = jnp.asarray(ws[1])
     model._jit_cache.clear()
     return model
