@@ -83,7 +83,7 @@ def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, p_ref, out_ref, hT_ref,
         cT_ref[:] = c_new.astype(cT_ref.dtype)
 
 
-def lstm_tile(B, H, T, rdtype_bytes=4, budget=13 << 20):
+def lstm_tile(B, H, rdtype_bytes=4, budget=13 << 20):
     """Largest hidden tile (multiple of 128, dividing H) whose working set
     fits the VMEM budget; None when even Hb=128 does not fit (fall back).
 
@@ -109,7 +109,7 @@ def _fused_recurrence(xg, R, h0, c0, peephole, *, interpret):
     (outputs [T, B, H], hT, cT)."""
     T, B, G = xg.shape
     H = G // 4
-    hb = lstm_tile(B, H, T, rdtype_bytes=R.dtype.itemsize)
+    hb = lstm_tile(B, H, rdtype_bytes=R.dtype.itemsize)
     if hb is None:
         raise ValueError(f"no VMEM-feasible LSTM tile for B={B}, H={H}")
     nj = H // hb
@@ -225,7 +225,7 @@ def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
 def _lstm_requires(x, h0, c0, W, R, b, *, peephole=None, **kw):
     # structural: a VMEM-feasible tile must exist
     H = R.shape[0]
-    return lstm_tile(x.shape[0], H, x.shape[1],
+    return lstm_tile(x.shape[0], H,
                      rdtype_bytes=R.dtype.itemsize) is not None
 
 

@@ -72,6 +72,7 @@ class EncodedGradientTrainer:
     """
 
     def __init__(self, loss_fn: Callable, updater, mesh, *, axis: str = "data",
+                 ici_axis: Optional[str] = None,
                  threshold: float = 1e-3, adaptive: bool = True,
                  target_density: float = 0.01, adapt_rate: float = 1.05,
                  residual_clip: float = 5.0):
@@ -87,6 +88,12 @@ class EncodedGradientTrainer:
         self.lr = updater.lr
         self.mesh = mesh
         self.axis = axis
+        # hierarchical (multi-slice) mode: gradients are pmean'd at FULL
+        # precision over the intra-slice ICI axis first; only the
+        # cross-slice ("dcn") exchange carries threshold-encoded messages —
+        # compression where bandwidth is actually scarce, exactly the
+        # reference's fast-local/encoded-remote split (Aeron tier, §2.4)
+        self.ici_axis = ici_axis
         self.threshold = threshold
         self.adaptive = adaptive
         self.target_density = target_density
@@ -119,9 +126,18 @@ class EncodedGradientTrainer:
         rate = self.adapt_rate
         lr = self.lr
 
+        ici_axis = self.ici_axis
+
         def local_step(carry, x, y):
             params = carry["params"]
             loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+            if ici_axis is not None:
+                # full-precision all-reduce inside the slice (ICI is cheap);
+                # u below is then identical across the slice, so the encoded
+                # exchange and residuals are per-slice quantities
+                g = jax.tree_util.tree_map(
+                    lambda t: lax.pmean(t, ici_axis), g)
+                loss = lax.pmean(loss, ici_axis)
             loss = lax.pmean(loss, axis)
             thr = carry["thr"]
             step_lr = lr(carry["step"]) if callable(lr) else lr
@@ -165,9 +181,11 @@ class EncodedGradientTrainer:
             "thr": rep,
             "step": rep,
         }
+        # hierarchical mode shards the global batch over BOTH axes
+        batch_spec = P((axis, ici_axis)) if ici_axis is not None else P(axis)
         fn = shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(carry_in_specs, P(axis), P(axis)),
+            in_specs=(carry_in_specs, batch_spec, batch_spec),
             out_specs=(carry_in_specs, rep),
         )
         return jax.jit(fn)

@@ -77,3 +77,44 @@ class DeviceMesh:
 
     def __exit__(self, *exc):
         return self._ctx.__exit__(*exc)
+
+
+def multi_slice_mesh(n_slices: int, axes: Sequence[str] = ("data",),
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh with a leading "dcn" axis grouping devices by slice.
+
+    Reference analog: the tier split in the reference's distributed stack —
+    fast intra-node exchange vs Aeron UDP across nodes (SURVEY.md §2.4).
+    TPU-native: collectives over the trailing axes ride ICI within a slice;
+    collectives over "dcn" cross the data-center network between slices.
+    On real multi-slice pods devices are grouped by their slice_index; on
+    virtual/CPU device sets they are split evenly in order, which is how the
+    driver's dryrun and the test harness simulate two slices on one host.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % n_slices:
+        raise ValueError(f"{n} devices not divisible into {n_slices} slices")
+    per = n // n_slices
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        devices.sort(key=lambda d: (d.slice_index, d.id))
+        # every "dcn" row must stay within ONE physical slice — mixing
+        # slices in a row would route the trailing (ICI) axis collectives
+        # over DCN, the exact slow path this mesh exists to avoid
+        for r in range(n_slices):
+            row = devices[r * per:(r + 1) * per]
+            if len({d.slice_index for d in row}) != 1:
+                n_real = len({d.slice_index for d in devices})
+                raise ValueError(
+                    f"n_slices={n_slices} does not match the pod's "
+                    f"{n_real} physical slices (a dcn row would span "
+                    f"multiple slices)")
+    shape = (n_slices, per)
+    arr = np.asarray(devices).reshape(shape)
+    if len(axes) != 1:
+        # split the per-slice extent over the trailing axes evenly by
+        # caller-specified factorization: axes like ("data", "model") with
+        # sizes inferred is ambiguous — require per-slice extent = product
+        raise ValueError("multi_slice_mesh currently takes one ICI axis; "
+                         "build custom shapes with jax.sharding.Mesh")
+    return Mesh(arr, ("dcn",) + tuple(axes))

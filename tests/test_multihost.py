@@ -15,15 +15,13 @@ import textwrap
 from pathlib import Path
 
 import numpy as np
-import pytest
 
-# Two-process Gloo collectives are timing-flaky on small shared VMs (the
-# handshake races under load), so this runs opt-in; the capability itself is
-# exercised on real multi-host pods where jax.distributed is the supported
-# transport. Enable with DL4J_TPU_MULTIHOST_TESTS=1.
-pytestmark = pytest.mark.skipif(
-    not os.environ.get("DL4J_TPU_MULTIHOST_TESTS"),
-    reason="multi-process Gloo test is opt-in (DL4J_TPU_MULTIHOST_TESTS=1)")
+# r2: in the default suite. The r1 opt-in skip blamed Gloo handshake races,
+# but the actual stall was dispatch-queue depth: hundreds of ASYNC-dispatched
+# cross-process collectives deadlock the Gloo transport. Jitting the step and
+# forcing completion every iteration (lockstep dispatch) makes the loop run
+# in ~2s here; real pods (TPU ICI/DCN transports) do not have this failure
+# mode, but lockstep costs nothing at test scale.
 
 _WORKER = textwrap.dedent("""\
 import os, sys
@@ -54,12 +52,13 @@ w = jax.device_put(jnp.zeros((4, 1), jnp.float32), NamedSharding(mesh, P()))
 def local_step(w, x, y):
     g = jax.grad(lambda w: ((x @ w - y) ** 2).mean())(w)
     return w - 0.05 * jax.lax.pmean(g, "data")
-step = shard_map(local_step, mesh=mesh,
-                 in_specs=(P(), P("data"), P("data")), out_specs=P())
+step = jax.jit(shard_map(local_step, mesh=mesh,
+                 in_specs=(P(), P("data"), P("data")), out_specs=P()))
 print(f"p{pid}: pre-loop", flush=True)
 with mesh:
     for i in range(200):
-        w = step(w, xg, yg)
+        # block each step: deep async queues of Gloo collectives deadlock
+        w = jax.block_until_ready(step(w, xg, yg))
 err = float(np.abs(np.asarray(jax.device_get(w)) - true_w).max())
 print(f"RESULT pid={pid} err={err:.4f}", flush=True)
 assert err < 0.05

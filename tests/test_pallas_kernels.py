@@ -160,11 +160,11 @@ class TestFusedLSTMTiled:
         from deeplearning4j_tpu.ops.pallas.fused_lstm import lstm_tile
 
         # small model: whole hidden fits in one tile
-        assert lstm_tile(8, 128, 16) == 128
+        assert lstm_tile(8, 128) == 128
         # the r1 failure case: H=1024/B=256 now gets a feasible tile
-        assert lstm_tile(256, 1024, 64) is not None
+        assert lstm_tile(256, 1024) is not None
         # absurd size: no tile fits -> requires() rejects, scan fallback
-        assert lstm_tile(8192, 8192, 8) is None
+        assert lstm_tile(8192, 8192) is None
 
 
 class TestPallasLRN:
