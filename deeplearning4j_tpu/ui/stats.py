@@ -34,6 +34,36 @@ def _tree_stats(tree, prefix: str) -> Dict[str, float]:
     return out
 
 
+def _named_layers(model):
+    """[(name, params_dict)] for MLN (indexed) or ComputationGraph (named)."""
+    params = model.params
+    if isinstance(params, dict):
+        return [(k, v) for k, v in params.items() if v]
+    return [(f"{i}_{type(l).__name__}", p)
+            for i, (l, p) in enumerate(zip(model.layers, params)) if p]
+
+
+def _flat(p) -> np.ndarray:
+    import jax
+
+    leaves = [np.asarray(x, np.float32).ravel()
+              for x in jax.tree_util.tree_leaves(p)]
+    return np.concatenate(leaves) if leaves else np.zeros(0, np.float32)
+
+
+def _histogram(a: np.ndarray, bins: int = 40):
+    # drop non-finite entries: a diverged model (NaN/inf weights) must not
+    # crash the monitoring listener (np.histogram raises on non-finite range)
+    a = a[np.isfinite(a)]
+    if a.size == 0:
+        return None
+    lo, hi = float(a.min()), float(a.max())
+    if hi <= lo:
+        hi = lo + 1e-12
+    counts, _ = np.histogram(a, bins=bins, range=(lo, hi))
+    return {"min": lo, "max": hi, "counts": counts.tolist()}
+
+
 class StatsListener(TrainingListener):
     """Collects per-iteration stats into a StatsStorage.
 
@@ -43,12 +73,20 @@ class StatsListener(TrainingListener):
     """
 
     def __init__(self, storage: StatsStorage, session_id: str = "default",
-                 update_frequency: int = 10, collect_param_stats: bool = True):
+                 update_frequency: int = 10, collect_param_stats: bool = True,
+                 collect_histograms: bool = True):
         self.storage = storage
         self.session_id = session_id
         self.update_frequency = max(1, update_frequency)
         self.collect_param_stats = collect_param_stats
+        # per-layer weight + update histograms (the reference UI's model
+        # page): updates are param DELTAS between successive samples — the
+        # same quantity the reference charts as "updates" (lr*gradient
+        # accumulated over the sampling window), computed host-side so the
+        # jitted train step is untouched
+        self.collect_histograms = collect_histograms
         self._last_time: Optional[float] = None
+        self._prev_flat: Dict[str, np.ndarray] = {}
 
     def iteration_done(self, model, iteration: int, epoch: int, score: float):
         now = time.perf_counter()
@@ -62,8 +100,20 @@ class StatsListener(TrainingListener):
         if self._last_time is not None:
             rec["iteration_time_ms"] = (now - self._last_time) * 1e3
         self._last_time = now
-        if self.collect_param_stats and iteration % self.update_frequency == 0:
-            rec.update(_tree_stats(model.params, "params"))
+        if iteration % self.update_frequency == 0:
+            if self.collect_param_stats:
+                rec.update(_tree_stats(model.params, "params"))
+            if self.collect_histograms:
+                hists: Dict = {}
+                for name, p in _named_layers(model):
+                    flat = _flat(p)
+                    entry = {"w": _histogram(flat)}
+                    prev = self._prev_flat.get(name)
+                    if prev is not None and prev.shape == flat.shape:
+                        entry["u"] = _histogram(flat - prev)
+                    self._prev_flat[name] = flat
+                    hists[name] = entry
+                rec["histograms"] = hists
         self.storage.put(rec)
 
     def on_epoch_end(self, model, epoch: int):

@@ -14,7 +14,8 @@ from typing import Dict, List, Optional
 
 
 # bookkeeping fields that are not chartable scalar series
-NON_SCALAR_KEYS = ("iteration", "epoch", "timestamp", "epoch_end")
+NON_SCALAR_KEYS = ("iteration", "epoch", "timestamp", "epoch_end",
+                   "histograms")
 
 
 class StatsStorage:

@@ -26,16 +26,35 @@ def build_model(seed: int = 123) -> MultiLayerNetwork:
     return MultiLayerNetwork(conf).init()
 
 
-def main(batch_size: int = 128, epochs: int = 1, n_examples: int | None = None):
+def main(batch_size: int = 128, epochs: int = 1, n_examples: int | None = None,
+         ui: bool = False):
     model = build_model()
-    model.set_listeners(ScoreIterationListener(50))
+    listeners = [ScoreIterationListener(50)]
+    server = None
+    if ui:
+        # live dashboard (UIServer analog): browse http://127.0.0.1:9000
+        # while training — loss curve + per-layer weight/update histograms
+        # refresh every 2s via the /data polling endpoint
+        from deeplearning4j_tpu.ui import (InMemoryStatsStorage,
+                                           StatsListener, UIServer)
+
+        storage = InMemoryStatsStorage()
+        listeners.append(StatsListener(storage, session_id="lenet-mnist",
+                                       update_frequency=10))
+        server = UIServer(port=9000).attach(storage).start()
+        print(f"live dashboard: http://127.0.0.1:{server.port}/")
+    model.set_listeners(*listeners)
     train = MnistDataSetIterator(batch_size, train=True, n_examples=n_examples)
     test = MnistDataSetIterator(batch_size, train=False, n_examples=n_examples)
     model.fit(train, epochs=epochs)
     ev = model.evaluate(test)
     print(ev.stats())
+    if server is not None:
+        server.stop()
     return ev
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(ui="--ui" in sys.argv)
