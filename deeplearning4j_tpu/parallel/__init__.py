@@ -10,6 +10,7 @@ under jax.distributed. TP/PP/SP are net-new capabilities the reference lacks.
 """
 
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh, multi_slice_mesh
+from deeplearning4j_tpu.parallel.param_averaging import ParameterAveragingTrainer
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.tensor_parallel import TensorParallel
@@ -31,7 +32,7 @@ from deeplearning4j_tpu.parallel.compression import (
     EncodedGradientTrainer, message_density, threshold_encode,
 )
 
-__all__ = ["DeviceMesh", "multi_slice_mesh", "ParallelWrapper", "ParallelInference", "TensorParallel",
+__all__ = ["DeviceMesh", "multi_slice_mesh", "ParameterAveragingTrainer", "ParallelWrapper", "ParallelInference", "TensorParallel",
            "GPipe", "pipeline_train_step", "stack_stage_params",
            "init_moe_params", "moe_param_specs", "place_moe_params",
            "switch_moe", "FaultTolerantTrainer", "initialize_distributed",

@@ -1,0 +1,134 @@
+"""Parameter averaging with local steps — the actual semantics of the
+reference's ParameterAveragingTrainingMaster (local SGD).
+
+Reference analog: org.deeplearning4j.spark.impl.paramavg.
+ParameterAveragingTrainingMaster — each Spark worker fits its replica for
+``averagingFrequency`` iterations on its own shard, then parameters are
+averaged cluster-wide (RDD reduce) and redistributed. Between averages the
+replicas genuinely DIVERGE; that divergence (and the reduced communication
+frequency) is the point of the algorithm — it is NOT equivalent to
+synchronous data-parallel SGD.
+
+TPU-native: replicas are a leading device axis on the param/optimizer trees,
+sharded over the mesh's data axis inside one SPMD program. Local steps touch
+no collective at all; every K-th step ends with one pmean of the params
+(and a pmean of the optimizer state, matching the reference's
+``averageUpdaterState=true`` default). The whole K-step round is a single
+``lax.scan`` inside one jitted shard_map call, so the per-step cost is the
+same fused train step the single-device path runs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel._compat import shard_map
+
+
+class ParameterAveragingTrainer:
+    """Local-SGD trainer: K local steps per replica, then average.
+
+    loss_fn(params, x, y) -> scalar loss on the LOCAL shard. ``updater`` is
+    any framework updater (stateful ones are fine: the state lives
+    per-replica and is averaged with the params, the reference's
+    averageUpdaterState behavior).
+    """
+
+    def __init__(self, loss_fn: Callable, updater, mesh, *,
+                 axis: str = "data", averaging_frequency: int = 1,
+                 average_updater_state: bool = True):
+        from deeplearning4j_tpu.optimize.updaters import get_updater
+
+        self.loss_fn = loss_fn
+        self.updater = get_updater(updater)
+        self.mesh = mesh
+        self.axis = axis
+        if int(averaging_frequency) < 1:
+            raise ValueError(f"averaging_frequency must be >= 1, got "
+                             f"{averaging_frequency}")
+        self.freq = int(averaging_frequency)
+        self.average_updater_state = average_updater_state
+        self._round = None
+
+    def init(self, params):
+        n = self.mesh.shape[self.axis]
+        rep = jax.tree_util.tree_map(
+            lambda p: jnp.broadcast_to(p[None], (n,) + p.shape), params)
+        opt = self.updater.init_state(params)
+        opt_rep = jax.tree_util.tree_map(
+            lambda s: jnp.broadcast_to(s[None], (n,) + s.shape), opt)
+        self._round = None  # re-init invalidates the cached compiled round
+        return {"params": rep, "opt": opt_rep, "step": jnp.asarray(0, jnp.int32)}
+
+    def _build(self, carry):
+        loss_fn, updater = self.loss_fn, self.updater
+        axis = self.axis
+        avg_opt = self.average_updater_state
+
+        def round_fn(carry, xs, ys):
+            """One averaging round: K purely-local steps, then ONE pmean.
+            xs/ys: [K, local_batch, ...] — K microbatches for this replica."""
+            params = jax.tree_util.tree_map(lambda t: t[0], carry["params"])
+            opt = jax.tree_util.tree_map(lambda t: t[0], carry["opt"])
+
+            def local_step(state, batch):
+                p, o, i = state
+                x, y = batch
+                loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+                upd, o2 = updater.update(g, o, p, i)
+                p2 = jax.tree_util.tree_map(lambda a, d: a - d, p, upd)
+                return (p2, o2, i + 1), loss
+
+            (params, opt, step), losses = lax.scan(
+                local_step, (params, opt, carry["step"]), (xs, ys))
+            # the round's single collective: average the diverged replicas
+            params = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), params)
+            if avg_opt:
+                opt = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), opt)
+            return ({"params": jax.tree_util.tree_map(lambda t: t[None], params),
+                     "opt": jax.tree_util.tree_map(lambda t: t[None], opt),
+                     "step": step},
+                    lax.pmean(losses.mean(), axis))
+
+        spec_rep = {
+            "params": jax.tree_util.tree_map(lambda _: P(axis),
+                                             carry["params"]),
+            "opt": jax.tree_util.tree_map(lambda _: P(axis), carry["opt"]),
+            "step": P(),
+        }
+        fn = shard_map(
+            round_fn, mesh=self.mesh,
+            in_specs=(spec_rep, P(None, axis), P(None, axis)),
+            out_specs=(spec_rep, P()),
+        )
+        return jax.jit(fn)
+
+    def fit_round(self, carry, x, y):
+        """One full averaging round over a global batch.
+
+        x/y: [K * global_batch, ...] — split into K sequential microbatches;
+        each replica sees K local shards, steps K times locally, then the
+        single parameter average runs. Returns (carry, mean loss)."""
+        if self._round is None:
+            self._round = self._build(carry)
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        K = self.freq
+        if x.shape[0] % K:
+            raise ValueError(f"batch {x.shape[0]} not divisible into "
+                             f"{K} local steps")
+        dp = self.mesh.shape[self.axis]
+        if (x.shape[0] // K) % dp:
+            raise ValueError(f"per-step batch {x.shape[0] // K} not "
+                             f"divisible by data-parallel degree {dp}")
+        xs = x.reshape((K, x.shape[0] // K) + x.shape[1:])
+        ys = y.reshape((K, y.shape[0] // K) + y.shape[1:])
+        return self._round(carry, xs, ys)
+
+    def params(self, carry):
+        """The (replica-identical) averaged params as a plain tree."""
+        return jax.tree_util.tree_map(lambda t: t[0], carry["params"])

@@ -8,9 +8,13 @@ executors and a parameter server to reconcile them. On TPU the SAME user
 intent ("train this config across the cluster") is one SPMD program over the
 mesh, so these shims keep the reference's surface (builder with
 batchSizePerWorker / averagingFrequency) while delegating to ParallelWrapper
-— averaging frequency is accepted and irrelevant: synchronous SPMD keeps
-replicas exactly equal every step, which is averaging at frequency 1 with
-zero communication code.
+for model-level training — synchronous SPMD is exact averaging at frequency
+1 with zero communication code.
+
+The REAL averaging_frequency>1 semantics (K genuinely-local steps per
+replica, then one parameter average — local SGD, which is NOT equivalent to
+sync DP) live in parallel/param_averaging.ParameterAveragingTrainer; use it
+directly when the reduced-communication algorithm itself is wanted.
 """
 
 from __future__ import annotations
