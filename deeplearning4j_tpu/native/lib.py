@@ -61,6 +61,22 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_pipe_batches_per_epoch.argtypes = [c.c_void_p]
     lib.dl4j_pipe_destroy.argtypes = [c.c_void_p]
 
+    lib.dl4j_imgpipe_create.restype = c.c_void_p
+    lib.dl4j_imgpipe_create.argtypes = [c.c_char_p, c.c_char_p, c.c_long,
+                                        c.c_long, c.c_long, c.c_long,
+                                        c.c_long, c.c_long, c.c_long,
+                                        c.c_long, c.c_int, c.c_int, c.c_uint,
+                                        c.POINTER(c.c_float),
+                                        c.POINTER(c.c_float), c.c_int,
+                                        c.c_int]
+    lib.dl4j_imgpipe_next.restype = c.c_int
+    lib.dl4j_imgpipe_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                      c.POINTER(c.c_float)]
+    lib.dl4j_imgpipe_reset.argtypes = [c.c_void_p]
+    lib.dl4j_imgpipe_batches_per_epoch.restype = c.c_long
+    lib.dl4j_imgpipe_batches_per_epoch.argtypes = [c.c_void_p]
+    lib.dl4j_imgpipe_destroy.argtypes = [c.c_void_p]
+
     lib.dl4j_csv_parse.restype = c.c_void_p
     lib.dl4j_csv_parse.argtypes = [c.c_char_p, c.c_char, c.c_int, c.c_int]
     lib.dl4j_csv_rows.restype = c.c_long

@@ -141,3 +141,188 @@ class _PyPipeline:
     def reset(self):
         self.epoch += 1
         self._reshuffle()
+
+
+def write_image_dataset(directory, images: np.ndarray, labels: np.ndarray
+                        ) -> Tuple[str, str]:
+    """uint8 [n, H, W, C] image export for the native image pipeline (4x
+    smaller at rest than float32; normalization happens in the C++ workers)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    f = directory / "images.u8"
+    l = directory / "labels.bin"
+    np.ascontiguousarray(images, np.uint8).tofile(f)
+    np.ascontiguousarray(labels, np.float32).tofile(l)
+    return str(f), str(l)
+
+
+class NativeImageDataSetIterator:
+    """ImageNet-class input path: threaded C++ decode->augment->normalize
+    producing float32 NHWC batches, with optional async DEVICE prefetch.
+
+    Reference analog: DataVec ImageRecordReader + ImagePreProcessingScaler +
+    AsyncDataSetIterator stacked — random crop + horizontal flip + per-
+    channel normalize run in native worker threads; ``device_prefetch``
+    stages the NEXT batch onto the accelerator while the current one trains
+    (the host->device overlap the reference gets from its prefetch queues).
+
+    augment=True: random crop to (crop_h, crop_w) + random horizontal flip,
+    fresh draws every epoch. augment=False: deterministic center crop (eval).
+    """
+
+    def __init__(self, img_path: str, label_path: str, n: int, image_shape,
+                 label_dim: int, batch_size: int, crop=None,
+                 shuffle: bool = True, augment: bool = True, seed: int = 0,
+                 mean=None, std=None, n_threads: int = 4, queue_cap: int = 4,
+                 device_prefetch: bool = False):
+        H, W, C = image_shape
+        crop_h, crop_w = crop if crop is not None else (H, W)
+        self.batch_size = batch_size
+        self.out_shape = (batch_size, crop_h, crop_w, C)
+        self.label_dim = label_dim
+        self._device_prefetch = device_prefetch
+        self._staged = None
+        mean = np.asarray(mean if mean is not None else [0.0] * C, np.float32)
+        std = np.asarray(std if std is not None else [1.0] * C, np.float32)
+        if mean.size != C or std.size != C:
+            raise ValueError(f"mean/std must have {C} channel entries")
+        self._lib = load_native_lib()
+        self._handle = None
+        self._py = None
+        self._exhausted = False
+        if self._lib is not None:
+            self._handle = self._lib.dl4j_imgpipe_create(
+                img_path.encode(), label_path.encode(), n, H, W, C,
+                label_dim, crop_h, crop_w, batch_size, int(shuffle),
+                int(augment), seed,
+                mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                n_threads, queue_cap)
+        if self._handle is None:
+            self._py = _PyImagePipeline(img_path, label_path, n, (H, W, C),
+                                        label_dim, (crop_h, crop_w),
+                                        batch_size, shuffle, augment, seed,
+                                        mean, std)
+        self._feat_buf = np.empty(self.out_shape, np.float32)
+        self._label_buf = np.empty((batch_size, label_dim), np.float32)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def batches_per_epoch(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.dl4j_imgpipe_batches_per_epoch(self._handle))
+        return self._py.n_batches
+
+    def _fetch_host(self):
+        """Next (features, labels) as host numpy, or None at epoch end."""
+        if self._handle is not None:
+            rc = self._lib.dl4j_imgpipe_next(
+                self._handle,
+                self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc == 1:
+                return None
+            if rc != 0:
+                raise RuntimeError("native image pipeline failed")
+            return self._feat_buf.copy(), self._label_buf.copy()
+        return self._py.next()
+
+    def _stage(self, host):
+        if host is None:
+            return None
+        if not self._device_prefetch:
+            return host
+        import jax
+
+        # async host->device: the transfer overlaps the consumer's compute
+        return tuple(jax.device_put(a) for a in host)
+
+    def __iter__(self):
+        # a finished epoch re-iterated without an explicit reset() advances
+        # the epoch ONCE here; fit() calls reset() itself between epochs, in
+        # which case _exhausted is already cleared and nothing double-resets
+        if self._exhausted:
+            self.reset()
+        if self._staged is None:  # keep an already-prefetched batch
+            self._staged = self._stage(self._fetch_host())
+        return self
+
+    def __next__(self) -> DataSet:
+        cur = self._staged
+        if cur is None:
+            self._exhausted = True
+            raise StopIteration
+        # stage the NEXT batch before handing the current one to the trainer
+        self._staged = self._stage(self._fetch_host())
+        return DataSet(cur[0], cur[1])
+
+    def reset(self):
+        if self._handle is not None:
+            self._lib.dl4j_imgpipe_reset(self._handle)
+        else:
+            self._py.reset()
+        self._staged = None
+        self._exhausted = False
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dl4j_imgpipe_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _PyImagePipeline:
+    """Numpy fallback with the same contract (different RNG stream)."""
+
+    def __init__(self, img_path, label_path, n, shape, label_dim, crop,
+                 batch, shuffle, augment, seed, mean, std):
+        H, W, C = shape
+        self.images = np.fromfile(img_path, np.uint8).reshape(n, H, W, C)
+        self.labels = np.fromfile(label_path, np.float32).reshape(n, label_dim)
+        self.crop = crop
+        self.batch = batch
+        self.shuffle = shuffle
+        self.augment = augment
+        self.seed = seed
+        self.epoch = 0
+        self.mean, self.std = mean, std
+        self.n_batches = n // batch
+        self._start()
+
+    def _start(self):
+        self._rng = np.random.default_rng(self.seed + self.epoch)
+        self._order = (self._rng.permutation(len(self.images)) if self.shuffle
+                       else np.arange(len(self.images)))
+        self._pos = 0
+
+    def next(self):
+        if self._pos >= self.n_batches:
+            return None
+        ch, cw = self.crop
+        H, W = self.images.shape[1:3]
+        idx = self._order[self._pos * self.batch:(self._pos + 1) * self.batch]
+        feats = np.empty((self.batch, ch, cw, self.images.shape[3]), np.float32)
+        for r, src in enumerate(idx):
+            if self.augment:
+                top = self._rng.integers(0, H - ch + 1)
+                left = self._rng.integers(0, W - cw + 1)
+                flip = bool(self._rng.integers(0, 2))
+            else:
+                top, left, flip = (H - ch) // 2, (W - cw) // 2, False
+            img = self.images[src, top:top + ch, left:left + cw]
+            if flip:
+                img = img[:, ::-1]
+            feats[r] = (img.astype(np.float32) / 255.0 - self.mean) / self.std
+        self._pos += 1
+        return feats, self.labels[idx].copy()
+
+    def reset(self):
+        self.epoch += 1
+        self._start()

@@ -17,6 +17,8 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <map>
+#include <functional>
 #include <dirent.h>
 #include <mutex>
 #include <new>
@@ -106,78 +108,72 @@ void dl4j_ws_destroy(void* handle) {
 }
 
 // ----------------------------------------------------------------- pipeline
-// Threaded prefetching batcher over two flat float32 binary files
-// (features [n, feat_dim], labels [n, label_dim]). Workers assemble shuffled
-// batches into a bounded queue; the consumer copies into caller buffers.
+// Threaded prefetching batchers. A shared ORDERED producer/consumer core:
+// workers claim batch indices from an atomic cursor, assemble batches via a
+// fill callback, and deliver them to the consumer IN BATCH ORDER (a
+// keyed reorder buffer — completion order of worker threads must not leak
+// into the data stream, or shuffle=false and per-seed reproducibility
+// break). Deadlock-freedom: the producer holding the next-to-deliver index
+// is always admitted even when the buffer is at capacity.
 struct Batch {
   std::vector<float> feats;
   std::vector<float> labels;
 };
 
-struct Pipeline {
-  std::vector<float> feats;   // memory-resident dataset (host staging)
-  std::vector<float> labels;
-  long n, feat_dim, label_dim, batch;
-  bool shuffle;
-  unsigned seed;
-  int queue_cap;
-  int n_threads;
-  unsigned epoch;
+struct BatchQueueCore {
+  long n_batches = 0;
+  int queue_cap = 4;
+  int n_threads = 2;
+  std::function<void(long, Batch&)> fill;
 
-  std::vector<long> order;
-  std::atomic<long> cursor;      // next batch index to produce
-  long n_batches;
-
-  std::deque<Batch> queue;
+  std::map<long, Batch> buffer;
+  long next_deliver = 0;
+  std::atomic<long> cursor{0};
+  std::atomic<bool> stop{false};
   std::mutex mu;
   std::condition_variable cv_produce, cv_consume;
   std::vector<std::thread> workers;
-  std::atomic<bool> stop;
-  std::atomic<long> produced;    // batches pushed this epoch
-
-  void make_order() {
-    order.resize(n);
-    for (long i = 0; i < n; ++i) order[i] = i;
-    if (shuffle) {
-      std::mt19937_64 rng(seed + epoch);
-      for (long i = n - 1; i > 0; --i) {
-        long j = static_cast<long>(rng() % static_cast<uint64_t>(i + 1));
-        std::swap(order[i], order[j]);
-      }
-    }
-  }
 
   void worker() {
     for (;;) {
       long b = cursor.fetch_add(1);
       if (b >= n_batches || stop.load()) return;
       Batch batch;
-      batch.feats.resize(static_cast<size_t>(this->batch) * feat_dim);
-      batch.labels.resize(static_cast<size_t>(this->batch) * label_dim);
-      for (long r = 0; r < this->batch; ++r) {
-        long src = order[b * this->batch + r];
-        std::memcpy(batch.feats.data() + r * feat_dim,
-                    feats.data() + src * feat_dim, feat_dim * sizeof(float));
-        std::memcpy(batch.labels.data() + r * label_dim,
-                    labels.data() + src * label_dim, label_dim * sizeof(float));
-      }
+      fill(b, batch);
       std::unique_lock<std::mutex> lk(mu);
       cv_produce.wait(lk, [&] {
-        return stop.load() || queue.size() < static_cast<size_t>(queue_cap);
+        return stop.load() || b == next_deliver ||
+               buffer.size() < static_cast<size_t>(queue_cap);
       });
       if (stop.load()) return;
-      queue.push_back(std::move(batch));
-      produced.fetch_add(1);
-      cv_consume.notify_one();
+      buffer.emplace(b, std::move(batch));
+      cv_consume.notify_all();
     }
   }
 
-  void start_workers(int n_threads) {
+  // 0 = delivered; 1 = epoch exhausted
+  int next(float* feat_out, float* label_out) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_consume.wait(lk, [&] {
+      return next_deliver >= n_batches || buffer.count(next_deliver) > 0;
+    });
+    if (next_deliver >= n_batches) return 1;
+    Batch b = std::move(buffer[next_deliver]);
+    buffer.erase(next_deliver);
+    ++next_deliver;
+    cv_produce.notify_all();
+    lk.unlock();
+    std::memcpy(feat_out, b.feats.data(), b.feats.size() * sizeof(float));
+    std::memcpy(label_out, b.labels.data(), b.labels.size() * sizeof(float));
+    return 0;
+  }
+
+  void start_workers() {
     stop.store(false);
     cursor.store(0);
-    produced.store(0);
+    next_deliver = 0;
     for (int i = 0; i < n_threads; ++i)
-      workers.emplace_back([this] { worker(); });
+      workers.emplace_back([this] { this->worker(); });
   }
 
   void join_workers() {
@@ -186,9 +182,25 @@ struct Pipeline {
     for (auto& t : workers)
       if (t.joinable()) t.join();
     workers.clear();
+    buffer.clear();
   }
 };
 
+static void make_shuffled_order(std::vector<long>& order, long n, bool shuffle,
+                                unsigned seed, unsigned epoch) {
+  order.resize(n);
+  for (long i = 0; i < n; ++i) order[i] = i;
+  if (shuffle) {
+    std::mt19937_64 rng(seed + epoch);
+    for (long i = n - 1; i > 0; --i) {
+      long j = static_cast<long>(rng() % static_cast<uint64_t>(i + 1));
+      std::swap(order[i], order[j]);
+    }
+  }
+}
+
+// one reader per element type (plain overloads: this file body carries C
+// linkage, which forbids templates)
 static bool read_file(const char* path, std::vector<float>& out, size_t count) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return false;
@@ -197,6 +209,42 @@ static bool read_file(const char* path, std::vector<float>& out, size_t count) {
   std::fclose(f);
   return got == count;
 }
+
+static bool read_file_u8(const char* path, std::vector<uint8_t>& out,
+                         size_t count) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  out.resize(count);
+  size_t got = std::fread(out.data(), 1, count, f);
+  std::fclose(f);
+  return got == count;
+}
+
+// Flat float32 pipeline (features [n, feat_dim], labels [n, label_dim]).
+struct Pipeline {
+  std::vector<float> feats;
+  std::vector<float> labels;
+  long n, feat_dim, label_dim, batch;
+  bool shuffle;
+  unsigned seed;
+  unsigned epoch;
+  std::vector<long> order;
+  BatchQueueCore core;
+
+  void fill(long b, Batch& out) {
+    out.feats.resize(static_cast<size_t>(batch) * feat_dim);
+    out.labels.resize(static_cast<size_t>(batch) * label_dim);
+    for (long r = 0; r < batch; ++r) {
+      long src = order[b * batch + r];
+      std::memcpy(out.feats.data() + r * feat_dim,
+                  feats.data() + src * feat_dim, feat_dim * sizeof(float));
+      std::memcpy(out.labels.data() + r * label_dim,
+                  labels.data() + src * label_dim, label_dim * sizeof(float));
+    }
+  }
+};
+
+extern "C" {
 
 void* dl4j_pipe_create(const char* feat_path, const char* label_path, long n,
                        long feat_dim, long label_dim, long batch, int shuffle,
@@ -216,53 +264,163 @@ void* dl4j_pipe_create(const char* feat_path, const char* label_path, long n,
   p->shuffle = shuffle != 0;
   p->seed = seed;
   p->epoch = 0;
-  p->queue_cap = queue_cap > 0 ? queue_cap : 4;
-  p->n_threads = n_threads > 0 ? n_threads : 2;
-  p->n_batches = n / batch;  // drop last partial, as the reference iterators do
-  p->make_order();
-  p->start_workers(p->n_threads);
+  p->core.queue_cap = queue_cap > 0 ? queue_cap : 4;
+  p->core.n_threads = n_threads > 0 ? n_threads : 2;
+  p->core.n_batches = n / batch;  // drop last partial, like the reference
+  p->core.fill = [p](long b, Batch& out) { p->fill(b, out); };
+  make_shuffled_order(p->order, n, p->shuffle, p->seed, p->epoch);
+  p->core.start_workers();
   return p;
 }
 
-// 0 = batch delivered; 1 = epoch exhausted (call reset); -1 = error
 int dl4j_pipe_next(void* handle, float* feat_out, float* label_out) {
   auto* p = static_cast<Pipeline*>(handle);
   if (!p) return -1;
-  std::unique_lock<std::mutex> lk(p->mu);
-  p->cv_consume.wait(lk, [&] {
-    return !p->queue.empty() || p->produced.load() >= p->n_batches;
-  });
-  if (p->queue.empty()) return 1;
-  Batch b = std::move(p->queue.front());
-  p->queue.pop_front();
-  p->cv_produce.notify_one();
-  lk.unlock();
-  std::memcpy(feat_out, b.feats.data(), b.feats.size() * sizeof(float));
-  std::memcpy(label_out, b.labels.data(), b.labels.size() * sizeof(float));
-  return 0;
+  return p->core.next(feat_out, label_out);
 }
 
 void dl4j_pipe_reset(void* handle) {
   auto* p = static_cast<Pipeline*>(handle);
-  p->join_workers();
-  {
-    std::lock_guard<std::mutex> lk(p->mu);
-    p->queue.clear();
-  }
+  p->core.join_workers();
   p->epoch += 1;  // reshuffle differently each epoch
-  p->make_order();
-  p->start_workers(p->n_threads);
+  make_shuffled_order(p->order, p->n, p->shuffle, p->seed, p->epoch);
+  p->core.start_workers();
 }
 
 long dl4j_pipe_batches_per_epoch(void* handle) {
-  return static_cast<Pipeline*>(handle)->n_batches;
+  return static_cast<Pipeline*>(handle)->core.n_batches;
 }
 
 void dl4j_pipe_destroy(void* handle) {
   auto* p = static_cast<Pipeline*>(handle);
-  p->join_workers();
+  p->core.join_workers();
   delete p;
 }
+
+}  // extern "C"
+
+// ------------------------------------------------------- image pipeline
+// ImageNet-class input path: uint8 [n, H, W, C] images staged in host
+// memory (4x smaller than f32), per-sample augmentation (random crop +
+// horizontal flip) and per-channel normalization done in worker THREADS
+// producing ready float32 NHWC batches — the decode->augment->prefetch
+// stage the reference runs in DataVec's image readers +
+// AsyncDataSetIterator. JPEG entropy decode is out of scope (no codec
+// library in the build environment); raw-uint8 is the storage format.
+struct ImagePipeline {
+  std::vector<uint8_t> images;  // [n, H, W, C]
+  std::vector<float> labels;    // [n, label_dim]
+  long n, H, W, C, label_dim, crop_h, crop_w, batch;
+  bool shuffle;
+  int augment;                  // 0: center crop, no flip (eval mode)
+  unsigned seed;
+  unsigned epoch;
+  std::vector<float> mean, stdev;
+  std::vector<long> order;
+  BatchQueueCore core;
+
+  void sample_into(long src, float* dst, std::mt19937_64& rng) {
+    long top = (H - crop_h) / 2, left = (W - crop_w) / 2;
+    bool flip = false;
+    if (augment) {
+      if (H > crop_h) top = static_cast<long>(rng() % (H - crop_h + 1));
+      if (W > crop_w) left = static_cast<long>(rng() % (W - crop_w + 1));
+      flip = (rng() & 1) != 0;
+    }
+    const uint8_t* img = images.data() + src * H * W * C;
+    for (long y = 0; y < crop_h; ++y) {
+      const uint8_t* row = img + ((top + y) * W + left) * C;
+      float* out_row = dst + y * crop_w * C;
+      for (long x = 0; x < crop_w; ++x) {
+        long sx = flip ? (crop_w - 1 - x) : x;
+        const uint8_t* px = row + sx * C;
+        float* out_px = out_row + x * C;
+        for (long c = 0; c < C; ++c)
+          out_px[c] = (static_cast<float>(px[c]) / 255.0f - mean[c]) / stdev[c];
+      }
+    }
+  }
+
+  void fill(long b, Batch& out) {
+    out.feats.resize(static_cast<size_t>(batch) * crop_h * crop_w * C);
+    out.labels.resize(static_cast<size_t>(batch) * label_dim);
+    for (long r = 0; r < batch; ++r) {
+      long src = order[b * batch + r];
+      // per-sample deterministic stream: reproducible given (seed, epoch,
+      // sample) regardless of which worker thread picks the batch up
+      std::mt19937_64 rng((static_cast<uint64_t>(seed + epoch) << 32)
+                          ^ static_cast<uint64_t>(src * 0x9E3779B97F4A7C15ULL));
+      sample_into(src, out.feats.data() + r * crop_h * crop_w * C, rng);
+      std::memcpy(out.labels.data() + r * label_dim,
+                  labels.data() + src * label_dim, label_dim * sizeof(float));
+    }
+  }
+};
+
+extern "C" {
+
+void* dl4j_imgpipe_create(const char* img_path, const char* label_path,
+                          long n, long H, long W, long C, long label_dim,
+                          long crop_h, long crop_w, long batch, int shuffle,
+                          int augment, unsigned seed, const float* mean,
+                          const float* stdev, int n_threads, int queue_cap) {
+  if (n <= 0 || batch <= 0 || H <= 0 || W <= 0 || C <= 0 || label_dim <= 0 ||
+      crop_h <= 0 || crop_w <= 0 || crop_h > H || crop_w > W)
+    return nullptr;
+  auto* p = new (std::nothrow) ImagePipeline();
+  if (!p) return nullptr;
+  if (!read_file_u8(img_path, p->images,
+                    static_cast<size_t>(n) * H * W * C) ||
+      !read_file(label_path, p->labels, static_cast<size_t>(n) * label_dim)) {
+    delete p;
+    return nullptr;
+  }
+  p->n = n; p->H = H; p->W = W; p->C = C;
+  p->label_dim = label_dim;
+  p->crop_h = crop_h; p->crop_w = crop_w;
+  p->batch = batch;
+  p->shuffle = shuffle != 0;
+  p->augment = augment;
+  p->seed = seed;
+  p->epoch = 0;
+  p->mean.assign(mean, mean + C);
+  p->stdev.assign(stdev, stdev + C);
+  for (long c = 0; c < C; ++c)
+    if (p->stdev[c] == 0.0f) { delete p; return nullptr; }
+  p->core.queue_cap = queue_cap > 0 ? queue_cap : 4;
+  p->core.n_threads = n_threads > 0 ? n_threads : 4;
+  p->core.n_batches = n / batch;
+  p->core.fill = [p](long b, Batch& out) { p->fill(b, out); };
+  make_shuffled_order(p->order, n, p->shuffle, p->seed, p->epoch);
+  p->core.start_workers();
+  return p;
+}
+
+int dl4j_imgpipe_next(void* handle, float* feat_out, float* label_out) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  if (!p) return -1;
+  return p->core.next(feat_out, label_out);
+}
+
+void dl4j_imgpipe_reset(void* handle) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  p->core.join_workers();
+  p->epoch += 1;  // new shuffle AND new augmentation draws each epoch
+  make_shuffled_order(p->order, p->n, p->shuffle, p->seed, p->epoch);
+  p->core.start_workers();
+}
+
+long dl4j_imgpipe_batches_per_epoch(void* handle) {
+  return static_cast<ImagePipeline*>(handle)->core.n_batches;
+}
+
+void dl4j_imgpipe_destroy(void* handle) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  p->core.join_workers();
+  delete p;
+}
+
+}  // extern "C"
 
 // ----------------------------------------------------------------- csv
 // Multi-threaded CSV -> float32 parser (DataVec CSVRecordReader's native
