@@ -872,7 +872,14 @@ class OnnxImportedGraph:
         acts: Dict[str, object] = dict(self.initializers)
         for k, v in feeds.items():
             acts[k] = jnp.asarray(v)
+        return self._run(acts, outputs)
+
+    def _run(self, acts: Dict[str, object],
+             outputs: Optional[List[str]] = None):
         for node in self.nodes:
+            node_outs = node.outputs or [node.name]
+            if all(o in acts for o in node_outs):
+                continue  # pre-folded constant (as_trainable bakes these)
             fn = ONNX_OP_REGISTRY.get(node.op)
             if fn is None:
                 raise NotImplementedError(
@@ -896,6 +903,95 @@ class OnnxImportedGraph:
             return self.output(feeds, outputs)
 
         return fn
+
+    def fold_constants(self, exclude=()):
+        """Evaluate every node reachable from Constants/initializers alone
+        (none of the graph inputs, none of ``exclude``) EAGERLY, returning
+        {output_name: numpy value}. Inside a jit trace all jnp calls are
+        traced even on concrete operands, so the exporter-emitted shape
+        arithmetic (Shape->Mul->Equal->Where feeding Expand/Reshape static
+        arguments) must be folded OUT-OF-TRACE beforehand — this is that
+        fold."""
+        known: Dict[str, object] = {k: v for k, v in self.initializers.items()
+                                    if k not in exclude}
+        folded: Dict[str, object] = {}
+        avail = set(known)
+        for node in self.nodes:
+            ins = [i for i in node.inputs if i]
+            fn = ONNX_OP_REGISTRY.get(node.op)
+            if fn is None or not all(i in avail for i in ins):
+                continue
+            xs = [(folded.get(i, known.get(i)) if i else None)
+                  for i in node.inputs]
+            try:
+                y = fn(node, xs)
+            except Exception:
+                continue  # leave for runtime (e.g. ops needing feeds)
+            outs = node.outputs or [node.name]
+            vals = y if isinstance(y, (list, tuple)) else [y]
+            for o, v in zip(outs, vals):
+                folded[o] = np.asarray(v)
+                avail.add(o)
+        return folded
+
+    # input positions read as STATIC arguments (np.asarray/int() in the
+    # mapper): initializers consumed here must stay concrete numpy, never
+    # traced params — a traced value would crash jit with a
+    # TracerArrayConversionError
+    _STATIC_ARG_POS = {
+        "Reshape": {1}, "Expand": {1}, "Slice": {1, 2, 3, 4},
+        "Squeeze": {1}, "Unsqueeze": {1}, "Tile": {1}, "TopK": {1},
+        "Pad": {1, 2, 3}, "ConstantOfShape": {0}, "Range": {0, 1, 2},
+        "OneHot": {1, 2}, "CumSum": {1}, "Split": {1}, "Trilu": {1},
+        "Resize": {1, 2, 3}, "ReduceMean": {1}, "ReduceSum": {1},
+        "ReduceMax": {1}, "ReduceMin": {1}, "ReduceProd": {1},
+        "ReduceL1": {1}, "ReduceL2": {1}, "ReduceLogSumExp": {1},
+        "ReduceSumSquare": {1},
+    }
+
+    def _static_arg_names(self):
+        out = set()
+        for node in self.nodes:
+            pos = self._STATIC_ARG_POS.get(node.op)
+            if not pos:
+                continue
+            for i, name in enumerate(node.inputs):
+                if i in pos and name:
+                    out.add(name)
+        return out
+
+    def as_trainable(self, outputs: Optional[List[str]] = None,
+                     trainable: Optional[List[str]] = None):
+        """(fn, params) for FINE-TUNING the imported model.
+
+        The reference's headline TF-import flow is import-then-train
+        (SURVEY §3.4: TFGraphMapper.importGraph -> SameDiff.fit). Here the
+        initializers become function ARGUMENTS instead of baked constants:
+        ``fn(params, feeds) -> outputs`` is jit/grad-able with respect to
+        ``params``. ``trainable`` restricts which initializers move (the
+        rest stay frozen constants); default: every float initializer.
+        """
+        import jax.numpy as jnp
+
+        if trainable is not None:
+            names = trainable
+        else:
+            static = self._static_arg_names()
+            names = [k for k, v in self.initializers.items()
+                     if np.issubdtype(np.asarray(v).dtype, np.floating)
+                     and np.ndim(v) >= 1 and k not in static]
+        params = {k: jnp.asarray(self.initializers[k]) for k in names}
+        baked = self.fold_constants(exclude=set(names))
+
+        def fn(params, feeds):
+            acts: Dict[str, object] = dict(self.initializers)
+            acts.update(baked)
+            acts.update(params)
+            for k, v in feeds.items():
+                acts[k] = jnp.asarray(v)
+            return self._run(acts, outputs)
+
+        return fn, params
 
 
 class OnnxModelImport:

@@ -1075,6 +1075,17 @@ class TFImportedGraph:
         f = node.attr("f").func_name
         return tuple(self._call_function(f, xs))
 
+    def _execute(self, acts: Dict[str, object],
+                 outputs: Optional[List[str]] = None):
+        """Shared execution tail: run non-Const nodes over ``acts`` and
+        resolve the requested outputs."""
+        self._exec_nodes([self.nodes[n] for n in self.order
+                          if self.nodes[n].op != "Const"], acts)
+        op_of = {k: n.op for k, n in self.nodes.items()}
+        res = [self._resolve(acts, o, op_of)
+               for o in (outputs or [self.order[-1]])]
+        return res[0] if len(res) == 1 else res
+
     def output(self, feeds: Dict[str, np.ndarray],
                outputs: Optional[List[str]] = None):
         """Execute the graph (InferenceSession.output analog)."""
@@ -1087,13 +1098,7 @@ class TFImportedGraph:
             acts[name] = const
         for name, val in feeds.items():
             acts[name] = jnp.asarray(val)
-        self._exec_nodes([self.nodes[n] for n in self.order
-                          if not (self.nodes[n].op == "Const")], acts)
-        if outputs is None:
-            outputs = [self.order[-1]]
-        op_of = {k: n.op for k, n in self.nodes.items()}
-        res = [self._resolve(acts, o, op_of) for o in outputs]
-        return res[0] if len(res) == 1 else res
+        return self._execute(acts, outputs)
 
     def as_function(self, outputs: Optional[List[str]] = None) -> Callable:
         """Jittable closure over the constants: fn(**feeds) -> outputs."""
@@ -1102,6 +1107,35 @@ class TFImportedGraph:
             return self.output(feeds, outputs)
 
         return fn
+
+    def as_trainable(self, outputs: Optional[List[str]] = None,
+                     trainable: Optional[List[str]] = None):
+        """(fn, params) for FINE-TUNING the imported frozen graph.
+
+        The reference's headline import flow is import-then-train (SURVEY
+        §3.4: TFGraphMapper.importGraph -> SameDiff.fit). Weight Consts
+        become function ARGUMENTS: ``fn(params, feeds) -> outputs`` is
+        jit/grad-able w.r.t. ``params``. Default trainable set: every
+        float Const with rank >= 1 (weights/biases); scalars (eps, scales)
+        and integer consts (shapes, axes — static-argument reads) stay
+        frozen numpy so jit tracing keeps them concrete.
+        """
+        import jax.numpy as jnp
+
+        names = trainable if trainable is not None else [
+            k for k, v in self.constants.items()
+            if np.issubdtype(np.asarray(v).dtype, np.floating)
+            and np.ndim(v) >= 1]
+        params = {k: jnp.asarray(self.constants[k]) for k in names}
+
+        def fn(params, feeds):
+            acts: Dict[str, object] = dict(self.constants)
+            acts.update(params)
+            for name, val in feeds.items():
+                acts[name] = jnp.asarray(val)
+            return self._execute(acts, outputs)
+
+        return fn, params
 
     def to_samediff(self):
         """Build a SameDiff graph from the imported GraphDef.

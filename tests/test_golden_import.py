@@ -168,3 +168,71 @@ class TestTracedControlFlow:
         out_neg = np.asarray(f(jnp.asarray(-np.abs(g["x"]))))
         np.testing.assert_allclose(out_neg, g["want_neg"], rtol=1e-5,
                                    atol=1e-5)
+
+
+class TestImportThenFineTune:
+    """The reference's import-then-train flow (SURVEY §3.4 / BASELINE config
+    #4): imported weights become function arguments, the whole imported
+    graph is jitted and differentiated, and a few optimizer steps reduce a
+    fine-tuning loss — on REAL framework artifacts."""
+
+    def test_real_bert_onnx_fine_tunes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.modelimport.onnx import OnnxModelImport
+
+        g = np.load(_fx("bert_golden.npz"))
+        imp = OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+        fn, params = imp.as_trainable(outputs=["pooler_output"])
+        feeds = {"input_ids": g["ids"], "attention_mask": g["mask"]}
+        # parity with the baked-weight path before any training
+        out0 = jax.jit(fn)(params, feeds)
+        np.testing.assert_allclose(np.asarray(out0), g["pooler"], atol=1e-5)
+
+        target = jnp.asarray(np.sign(g["pooler"]).astype(np.float32))
+
+        @jax.jit
+        def step(p):
+            loss, grads = jax.value_and_grad(
+                lambda p: ((fn(p, feeds) - target) ** 2).mean())(p)
+            return jax.tree.map(lambda a, b: a - 0.05 * b, p, grads), loss
+
+        losses = []
+        for _ in range(20):
+            params, loss = step(params)
+            losses.append(float(loss))
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+    def test_tf_frozen_cnn_fine_tunes(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("tf_small_cnn_golden.npz"))
+        imp = TFGraphMapper.import_graph(_fx("tf_small_cnn.pb"))
+        ph = str(g["placeholder"])
+        probe = [str(p) for p in g["probe"]]
+        softmax = [n for n in probe if "softmax" in n.lower()][-1]
+        fn, params = imp.as_trainable(outputs=[softmax])
+        assert params, "no trainable consts found"
+        out0 = jax.jit(fn)(params, {ph: g["x"]})
+        want = g[f"node_{probe.index(softmax)}"]
+        np.testing.assert_allclose(np.asarray(out0), want, atol=1e-4)
+
+        labels = jnp.asarray(np.eye(out0.shape[-1], dtype=np.float32)[[0, 1]])
+
+        @jax.jit
+        def step(p):
+            def loss_fn(p):
+                pred = fn(p, {ph: g["x"]})
+                return -(labels * jnp.log(jnp.maximum(pred, 1e-7))).sum(-1).mean()
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            return jax.tree.map(lambda a, b: a - 0.05 * b, p, grads), loss
+
+        losses = []
+        for _ in range(15):
+            params, loss = step(params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
