@@ -29,6 +29,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -344,3 +345,205 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
         check_vma=_check_vma,
     )
     return fn(params, x)
+
+
+# --------------------------------------------------------------------------
+# zig-zag (load-balanced) causal ring attention
+# --------------------------------------------------------------------------
+#
+# With contiguous sequence sharding, causal masking makes the ring
+# triangular: device 0 attends 1 block, device n-1 attends n — wall-clock is
+# set by the last device while the rest idle. Zig-zag sharding gives every
+# device TWO stripes, one from each end (device i holds stripes i and
+# 2n-1-i of 2n), which balances the visible work exactly: at t=0 each
+# device runs two diagonal tiles + one full tile; at every later step each
+# device runs exactly two full tiles (the pair (b_i, a_s) is always
+# visible, and exactly one of (a_i, a_s) / (b_i, b_s) is, depending on the
+# sign of i - s). The flash kernels stay the per-tile core, and the
+# backward rotates dk/dv carries with their blocks exactly like the
+# contiguous ring.
+
+
+def zigzag_permutation(T: int, n: int):
+    """(perm, inverse): sequence index permutation placing stripes
+    [i, 2n-1-i] on device i. T must divide into 2n stripes."""
+    S = T // (2 * n)
+    order = []
+    for i in range(n):
+        order.extend(range(i * S, (i + 1) * S))
+        order.extend(range((2 * n - 1 - i) * S, (2 * n - i) * S))
+    perm = np.asarray(order)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    return perm, inv
+
+
+def _zz_none(B, H, S, D):
+    return (jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.full((B, H, S, 1), -jnp.inf, jnp.float32))
+
+
+def _ring_zigzag_fwd_impl(q, k, v, axis, scale, block_q, block_k):
+    from deeplearning4j_tpu.ops.pallas.flash_attention import flash_block_fwd
+
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    B, H, Tl, D = q.shape
+    S = Tl // 2
+    blk = functools.partial(flash_block_fwd, scale=scale,
+                            block_q=block_q, block_k=block_k, vma=(axis,))
+    qa, qb = q[:, :, :S], q[:, :, S:]
+    ka, kb = k[:, :, :S], k[:, :, S:]
+    va, vb = v[:, :, :S], v[:, :, S:]
+
+    # t = 0: (a,a) diag, (b,b) diag, (b,a) full — all static
+    oa, la = blk(qa, ka, va, causal=True)
+    oa, la = oa.astype(jnp.float32), la
+    ob1, lb1 = blk(qb, kb, vb, causal=True)
+    ob2, lb2 = blk(qb, ka, va, causal=False)
+    ob, lb = _merge_lse(ob1.astype(jnp.float32), lb1, ob2, lb2)
+
+    k_cur, v_cur = k, v
+    for t in range(1, n):
+        k_cur = _rotate(k_cur, axis, n)
+        v_cur = _rotate(v_cur, axis, n)
+        kac, kbc = k_cur[:, :, :S], k_cur[:, :, S:]
+        vac, vbc = v_cur[:, :, :S], v_cur[:, :, S:]
+        s = (my - t) % n
+        # always visible: (b_i, a_s) full
+        ob_c, lb_c = blk(qb, kac, vac, causal=False)
+        ob, lb = _merge_lse(ob, lb, ob_c, lb_c)
+        # exactly one of (a_i, a_s) / (b_i, b_s), by sign of i - s
+        def _f32(pair):
+            o, l = pair
+            return o.astype(jnp.float32), l  # match the dead branch's dtype
+
+        contrib = lax.cond(
+            my > s,
+            lambda kv: (*_f32(blk(qa, kv[0], kv[1], causal=False)),
+                        *_zz_none(B, H, S, D)),
+            lambda kv: (*_zz_none(B, H, S, D),
+                        *_f32(blk(qb, kv[2], kv[3], causal=False))),
+            (kac, vac, kbc, vbc))
+        oa_c, la_c, ob2_c, lb2_c = contrib
+        oa, la = _merge_lse(oa, la, oa_c, la_c)
+        ob, lb = _merge_lse(ob, lb, ob2_c, lb2_c)
+    out = jnp.concatenate([oa, ob], axis=2).astype(q.dtype)
+    lse = jnp.concatenate([la, lb], axis=2)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_zigzag(q, k, v, axis, scale, block_q, block_k):
+    return _ring_zigzag_fwd_impl(q, k, v, axis, scale, block_q, block_k)[0]
+
+
+def _ring_zigzag_vjp_fwd(q, k, v, axis, scale, block_q, block_k):
+    o, lse = _ring_zigzag_fwd_impl(q, k, v, axis, scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_zigzag_vjp_bwd(axis, scale, block_q, block_k, res, do):
+    from deeplearning4j_tpu.ops.pallas.flash_attention import (bwd_tiles,
+                                                               flash_block_bwd)
+
+    q, k, v, o, lse = res
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    B, H, Tl, D = q.shape
+    S = Tl // 2
+    bwq, bwk = bwd_tiles(block_q, block_k, D)
+    blk = functools.partial(flash_block_bwd, scale=scale,
+                            block_q=bwq, block_k=bwk, vma=(axis,))
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1,
+                                                                 keepdims=True)
+    qa, qb = q[:, :, :S], q[:, :, S:]
+    doa, dob = do[:, :, :S], do[:, :, S:]
+    la, lb = lse[:, :, :S], lse[:, :, S:]
+    da, db = delta[:, :, :S], delta[:, :, S:]
+
+    zq = jnp.zeros((B, H, S, D), jnp.float32)
+    dqa = _pvary(zq, (axis,))
+    dqb = _pvary(zq, (axis,))
+    dk_carry = _pvary(jnp.zeros(k.shape, jnp.float32), (axis,))
+    dv_carry = _pvary(jnp.zeros(v.shape, jnp.float32), (axis,))
+    k_cur, v_cur = k, v
+    for t in range(n):
+        kac, kbc = k_cur[:, :, :S], k_cur[:, :, S:]
+        vac, vbc = v_cur[:, :, :S], v_cur[:, :, S:]
+        dka = jnp.zeros((B, H, S, D), jnp.float32)
+        dva = jnp.zeros((B, H, S, D), jnp.float32)
+        dkb = jnp.zeros((B, H, S, D), jnp.float32)
+        dvb = jnp.zeros((B, H, S, D), jnp.float32)
+        if t == 0:
+            g1 = blk(qa, kac, vac, doa, la, da, causal=True)
+            dqa, dka, dva = dqa + g1[0], dka + g1[1], dva + g1[2]
+            g2 = blk(qb, kbc, vbc, dob, lb, db, causal=True)
+            dqb, dkb, dvb = dqb + g2[0], dkb + g2[1], dvb + g2[2]
+            g3 = blk(qb, kac, vac, dob, lb, db, causal=False)
+            dqb, dka, dva = dqb + g3[0], dka + g3[1], dva + g3[2]
+        else:
+            s = (my - t) % n
+            g3 = blk(qb, kac, vac, dob, lb, db, causal=False)
+            dqb, dka, dva = dqb + g3[0], dka + g3[1], dva + g3[2]
+            ga, gb = lax.cond(
+                my > s,
+                lambda kv: (blk(qa, kv[0], kv[1], doa, la, da, causal=False),
+                            (zq, zq, zq)),
+                lambda kv: ((zq, zq, zq),
+                            blk(qb, kv[2], kv[3], dob, lb, db, causal=False)),
+                (kac, vac, kbc, vbc))
+            dqa, dka, dva = dqa + ga[0], dka + ga[1], dva + ga[2]
+            dqb, dkb, dvb = dqb + gb[0], dkb + gb[1], dvb + gb[2]
+        dk_carry = dk_carry + jnp.concatenate([dka, dkb], axis=2)
+        dv_carry = dv_carry + jnp.concatenate([dva, dvb], axis=2)
+        # carries rotate with K/V every step incl. the last (lands home);
+        # K/V skip the final dead hop
+        if t < n - 1:
+            k_cur = _rotate(k_cur, axis, n)
+            v_cur = _rotate(v_cur, axis, n)
+        dk_carry = _rotate(dk_carry, axis, n)
+        dv_carry = _rotate(dv_carry, axis, n)
+    dq = jnp.concatenate([dqa, dqb], axis=2)
+    return (dq.astype(q.dtype), dk_carry.astype(k.dtype),
+            dv_carry.astype(v.dtype))
+
+
+_ring_zigzag.defvjp(_ring_zigzag_vjp_fwd, _ring_zigzag_vjp_bwd)
+
+
+def _ring_zigzag_local(q, k, v, *, axis, scale, block_q=512, block_k=1024):
+    return _ring_zigzag(q, k, v, axis, scale,
+                        min(block_q, q.shape[2] // 2),
+                        min(block_k, k.shape[2] // 2))
+
+
+def ring_attention_zigzag(q, k, v, mesh, *, axis: str = "seq",
+                          scale: float | None = None):
+    """Load-balanced CAUSAL ring attention (zig-zag stripe sharding).
+
+    Takes/returns NORMAL sequence order ([B, H, T, D]); the stripe
+    permutation is applied internally. At scale, pre-permute the data once
+    and call the local core inside your own shard_map instead to avoid the
+    per-call gather. Requires T % (2 * mesh axis size) == 0 and the flash
+    kernel's alignment (head_dim % 128 == 0)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = mesh.shape[axis]
+    T = q.shape[2]
+    if T % (2 * n):
+        raise ValueError(f"zigzag needs T ({T}) divisible by 2*{n} stripes")
+    if not _flash_core_ok(q.shape[-1], T // (2 * n)):
+        raise ValueError("zigzag ring runs on the flash core: needs "
+                         "head_dim % 128 == 0 and stripe length % 8 == 0")
+    perm, inv = zigzag_permutation(T, n)
+    fn = shard_map(
+        functools.partial(_ring_zigzag_local, axis=axis, scale=scale),
+        mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None),
+        check_vma=False,  # pallas interpret-mode VMA limitation (see above)
+    )
+    out = fn(jnp.take(q, perm, axis=2), jnp.take(k, perm, axis=2),
+             jnp.take(v, perm, axis=2))
+    return jnp.take(out, inv, axis=2)

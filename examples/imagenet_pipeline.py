@@ -40,6 +40,7 @@ def main(n: int = 64, stored: int = 40, crop: int = 32, classes: int = 10,
                      dtype="bf16").init()
     model.fit(train, epochs=epochs)
     print("final loss:", model.score_value)
+    return model.score_value
 
 
 if __name__ == "__main__":

@@ -53,3 +53,10 @@ def test_long_context():
     shape, gnorm = long_context.main(T=256, d_model=16, n_heads=4)
     assert shape == (1, 256, 16)
     assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_imagenet_pipeline():
+    import imagenet_pipeline
+
+    loss = imagenet_pipeline.main(n=32, stored=36, crop=32, batch=8, epochs=1)
+    assert np.isfinite(float(loss))

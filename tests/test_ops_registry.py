@@ -125,6 +125,13 @@ class TestStructuralRequires:
         h0 = c0 = jnp.zeros((8, 128))
         W, R, b = jnp.zeros((16, 512)), jnp.zeros((128, 512)), jnp.zeros(512)
         assert op.select(x, h0, c0, W, R, b).platform == "pallas"
-        # peephole is a structural no -> scan path even under force
+        # r2: peepholes are fused in-kernel; the structural no is now a
+        # VMEM-infeasible tile (lstm_tile returns None -> scan fallback)
         assert op.select(x, h0, c0, W, R, b,
-                         peephole=jnp.zeros(384)).platform == "xla"
+                         peephole=jnp.zeros(384)).platform == "pallas"
+        huge_h = 8192
+        assert op.select(jnp.zeros((8192, 4, 16)),
+                         jnp.zeros((8192, huge_h)), jnp.zeros((8192, huge_h)),
+                         jnp.zeros((16, 4 * huge_h)),
+                         jnp.zeros((huge_h, 4 * huge_h)),
+                         jnp.zeros(4 * huge_h)).platform == "xla"
