@@ -15,7 +15,12 @@ from deeplearning4j_tpu.ops.registry import (
     register_impl,
     register_op,
 )
-from deeplearning4j_tpu.ops import activations, losses  # noqa: F401  (populate registries)
+# populate the registries: every module defining an XLA reference lowering
+# must load BEFORE the pallas kernels register over them — an accelerated
+# impl without its reference would make registry fallback a KeyError
+from deeplearning4j_tpu.ops import (  # noqa: F401
+    activations, attention, convolution, losses, recurrent, rng,
+)
 from deeplearning4j_tpu.ops import pallas  # noqa: F401  (register accelerated kernels)
 
 __all__ = ["OpImpl", "get_op", "op", "register_impl", "register_op"]
