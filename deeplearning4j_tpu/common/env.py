@@ -33,6 +33,9 @@ class Environment:
     VERBOSE = "DL4J_TPU_VERBOSE"
     # Per-op timing profiler (org.nd4j.linalg.profiler.OpProfiler analog).
     PROFILING = "DL4J_TPU_PROFILING"
+    # Force the fused LSTM to take the scan-recompute backward instead of
+    # the Pallas backward kernel (A/B measurement + escape hatch).
+    LSTM_SCAN_BWD = "DL4J_TPU_LSTM_SCAN_BWD"
 
     def __init__(self) -> None:
         self.reload()
@@ -43,6 +46,7 @@ class Environment:
         self.nan_panic = _flag(self.NAN_PANIC)
         self.verbose = _flag(self.VERBOSE)
         self.profiling = _flag(self.PROFILING)
+        self.lstm_scan_bwd = _flag(self.LSTM_SCAN_BWD)
 
 
 env = Environment()

@@ -347,3 +347,130 @@ class TestFusedLSTMGradients:
                 x, h0, c0, W, R, b, peephole=peep)[0].sum())(W)
             np.testing.assert_allclose(np.asarray(gk), np.asarray(gs),
                                        rtol=2e-4, atol=2e-5)
+
+
+class TestFusedLSTMBackwardKernel:
+    """The dedicated reverse-time Pallas backward kernel (the
+    cudnnRNNBackwardData-parity pass) vs autodiff through the scan lowering.
+    """
+
+    def _mk(self, rng, B, T, F, H, scale=0.1):
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * scale)
+        c0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * scale)
+        W = jnp.asarray(rng.normal(size=(F, 4 * H)).astype(np.float32) * scale)
+        R = jnp.asarray(rng.normal(size=(H, 4 * H)).astype(np.float32) * scale)
+        b = jnp.asarray(rng.normal(size=(4 * H,)).astype(np.float32) * scale)
+        p = jnp.asarray(rng.normal(size=(3 * H,)).astype(np.float32) * scale)
+        return x, h0, c0, W, R, b, p
+
+    def test_bwd_is_kernel_not_recompute(self, monkeypatch):
+        """The vjp must run the Pallas backward kernel, not fall back to
+        autodiff through the scan."""
+        import deeplearning4j_tpu.ops.pallas.fused_lstm as fl
+
+        called = []
+        orig = fl._bwd_recurrence
+
+        def spy(*a, **kw):
+            called.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fl, "_bwd_recurrence", spy)
+        x = jnp.ones((8, 3, 8), jnp.float32)
+        h0 = jnp.zeros((8, 128))
+        W = jnp.ones((8, 512), jnp.float32) * 0.01
+        R = jnp.ones((128, 512), jnp.float32) * 0.01
+        b = jnp.zeros((512,))
+        jax.grad(lambda W: fl.fused_lstm_layer(
+            x, h0, h0, W, R, b)[0].sum())(W)
+        assert called, "LSTM backward kernel was not used in the vjp"
+
+    @pytest.mark.parametrize("reverse", [False, True])
+    @pytest.mark.parametrize("peephole", [False, True])
+    def test_all_argnum_grads_match_scan(self, rng, reverse, peephole):
+        """Gradients wrt every differentiable input, with cotangents flowing
+        through the sequence output AND the (hT, cT) final-state outputs."""
+        B, T, F, H = 8, 5, 8, 128
+        x, h0, c0, W, R, b, p = self._mk(rng, B, T, F, H)
+        peep = p if peephole else None
+        wseq = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32))
+
+        def loss(fn, *args):
+            out, (hT, cT) = fn(*args, peephole=peep, forget_gate_bias=1.0,
+                               reverse=reverse)
+            return (out * wseq).sum() + 0.5 * hT.sum() + 0.25 * (cT ** 2).sum()
+
+        args = (x, h0, c0, W, R, b)
+        argnums = tuple(range(6))
+        gk = jax.grad(lambda *a: loss(fused_lstm_layer, *a), argnums)(*args)
+        gs = jax.grad(lambda *a: loss(lstm_layer, *a), argnums)(*args)
+        for name, a, b_ in zip(("x", "h0", "c0", "W", "R", "b"), gk, gs):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5,
+                err_msg=f"d{name} reverse={reverse} peephole={peephole}")
+        if peephole:
+            gpk = jax.grad(lambda pp: loss(
+                lambda *a, **k: fused_lstm_layer(*a, **{**k, "peephole": pp}),
+                *args))(p)
+            gps = jax.grad(lambda pp: loss(
+                lambda *a, **k: lstm_layer(*a, **{**k, "peephole": pp}),
+                *args))(p)
+            np.testing.assert_allclose(np.asarray(gpk), np.asarray(gps),
+                                       rtol=2e-4, atol=2e-5, err_msg="dp")
+
+    def test_big_shape_hidden_tiled_parity(self, rng):
+        """H=1024/B=256 — the shape the VERDICT names: the bwd tile selector
+        must pick a real hidden tile (128) and the tiled kernel's gradients
+        must match the scan."""
+        from deeplearning4j_tpu.ops.pallas.fused_lstm import lstm_bwd_tile
+
+        assert lstm_bwd_tile(256, 1024) == 128
+        B, T, F, H = 256, 3, 16, 1024
+        x, h0, c0, W, R, b, p = self._mk(rng, B, T, F, H, scale=0.02)
+        gk = jax.grad(lambda R: fused_lstm_layer(
+            x, h0, c0, W, R, b, peephole=p)[0].sum())(R)
+        gs = jax.grad(lambda R: lstm_layer(
+            x, h0, c0, W, R, b, peephole=p)[0].sum())(R)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gs),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bwd_tile_budget(self):
+        from deeplearning4j_tpu.ops.pallas.fused_lstm import lstm_bwd_tile
+
+        assert lstm_bwd_tile(8, 128) == 128
+        # pathological: never fits
+        assert lstm_bwd_tile(8192, 8192) is None
+
+    def test_scan_fallback_flag(self, rng, monkeypatch):
+        """DL4J_TPU_LSTM_SCAN_BWD forces the recompute path (A/B switch);
+        gradients must be identical either way."""
+        import deeplearning4j_tpu.ops.pallas.fused_lstm as fl
+        from deeplearning4j_tpu.common.env import env
+
+        called = []
+        orig = fl._bwd_recurrence
+        monkeypatch.setattr(fl, "_bwd_recurrence",
+                            lambda *a, **k: (called.append(1), orig(*a, **k))[1])
+        B, T, F, H = 8, 4, 8, 128
+        x, h0, c0, W, R, b, p = self._mk(rng, B, T, F, H)
+        g_kernel = jax.grad(lambda W: fl.fused_lstm_layer(
+            x, h0, c0, W, R, b, peephole=p)[0].sum())(W)
+        assert called
+        called.clear()
+        monkeypatch.setattr(env, "lstm_scan_bwd", True)
+        g_scan = jax.grad(lambda W: fl.fused_lstm_layer(
+            x, h0, c0, W, R, b, peephole=p)[0].sum())(W)
+        assert not called, "flag did not force the scan backward"
+        np.testing.assert_allclose(np.asarray(g_kernel), np.asarray(g_scan),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bf16_finite(self, rng):
+        B, T, F, H = 8, 4, 8, 128
+        x, h0, c0, W, R, b, p = self._mk(rng, B, T, F, H)
+        cast = lambda t: t.astype(jnp.bfloat16)
+        g = jax.grad(lambda W: fused_lstm_layer(
+            cast(x), cast(h0), cast(c0), W, cast(R), cast(b),
+            peephole=cast(p))[0].astype(jnp.float32).sum())(cast(W))
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(g, np.float32)).all()
