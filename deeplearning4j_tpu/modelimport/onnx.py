@@ -10,6 +10,7 @@ boundary so the compute path stays TPU-friendly.
 
 from __future__ import annotations
 
+import logging
 import struct
 from typing import Callable, Dict, List, Optional
 
@@ -925,8 +926,14 @@ class OnnxImportedGraph:
                   for i in node.inputs]
             try:
                 y = fn(node, xs)
-            except Exception:
-                continue  # leave for runtime (e.g. ops needing feeds)
+            except Exception as e:
+                # Expected for ops whose mapper needs runtime feeds or jit
+                # context; logged so a genuine mapper bug is not silently
+                # deferred into a confusing in-trace error later.
+                logging.getLogger(__name__).debug(
+                    "fold_constants: deferring %s node %r to runtime (%s: %s)",
+                    node.op, node.name, type(e).__name__, e)
+                continue
             outs = node.outputs or [node.name]
             vals = y if isinstance(y, (list, tuple)) else [y]
             for o, v in zip(outs, vals):

@@ -539,9 +539,13 @@ def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
 
 
 def _lstm_requires(x, h0, c0, W, R, b, *, peephole=None, **kw):
-    # structural: a VMEM-feasible tile must exist (incl. reserve outputs)
+    # structural: a VMEM-feasible tile must exist (incl. reserve outputs),
+    # sized with the SAME panel dtype _fused_recurrence will actually use
+    # (f32 in interpret mode, bf16 on TPU)
     H = R.shape[0]
-    return lstm_tile(x.shape[0], H, save_residuals=True) is not None
+    rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
+    return lstm_tile(x.shape[0], H, rdtype_bytes=rb,
+                     save_residuals=True) is not None
 
 
 def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
@@ -553,8 +557,10 @@ def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
     (0.6-0.9x measured at B=256, H=512/1024) — those shapes stay on XLA,
     numbers in BASELINE.md."""
     H = R.shape[0]
+    rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
     return (H % 128 == 0 and x.shape[0] % 8 == 0
-            and lstm_tile(x.shape[0], H, save_residuals=True) == H)
+            and lstm_tile(x.shape[0], H, rdtype_bytes=rb,
+                          save_residuals=True) == H)
 
 
 register_impl("lstm_layer", platform="pallas", predicate=_lstm_applicable,

@@ -86,7 +86,16 @@ def _rotate(x, axis, axis_size):
 
 
 def _merge_lse(o, lse, o_i, lse_i):
-    """Combine two softmax partial results normalized with their own lse."""
+    """Combine two softmax partial results normalized with their own lse.
+
+    The flash forward kernel emits lse=+inf for fully-masked rows (so its
+    backward's exp(s - lse) is exactly 0). For the MERGE contract +inf is
+    poison — logaddexp(x, +inf)=+inf would zero both weights and discard the
+    other side's accumulated rows — so normalize the sentinel to -inf ("this
+    side contributes nothing") before merging. Relevant for cross-attention
+    or unequal q/k lengths where a ring step can see fully-masked rows."""
+    lse = jnp.where(jnp.isposinf(lse), -jnp.inf, lse)
+    lse_i = jnp.where(jnp.isposinf(lse_i), -jnp.inf, lse_i)
     lse_new = jnp.logaddexp(lse, lse_i)
     w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
     w_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - lse_new), 0.0)
@@ -226,6 +235,11 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
     if impl is None:
         local, check_vma = _select_ring_core(q.shape[-1], q.shape[2] // size)
     elif impl == "flash":
+        if not _flash_core_ok(q.shape[-1], q.shape[2] // size):
+            raise ValueError(
+                "ring_attention(impl='flash') needs head_dim % 128 == 0 and "
+                f"local seq % 8 == 0; got head_dim={q.shape[-1]}, "
+                f"T_local={q.shape[2] // size} — use impl='einsum' or pad")
         local, check_vma = _ring_flash_local, False
     else:
         local, check_vma = _ring_attention_local, True

@@ -53,7 +53,14 @@ class InMemoryStatsStorage(StatsStorage):
 
 
 class FileStatsStorage(StatsStorage):
-    """Append-only JSONL file store."""
+    """Append-only JSONL file store.
+
+    ``records`` keeps an in-process parse cache keyed by file offset: each
+    call reads and parses only the bytes appended since the previous call,
+    so the UI's 2-second /data poll stays O(new records) over a long
+    training run instead of re-parsing the whole history every poll. An
+    externally truncated/rewritten file (offset shrank) invalidates the
+    cache and triggers a full re-read."""
 
     def __init__(self, path: str | Path):
         self._path = Path(path)
@@ -61,6 +68,9 @@ class FileStatsStorage(StatsStorage):
         self._path.parent.mkdir(parents=True, exist_ok=True)
         if not self._path.exists():
             self._path.touch()
+        self._cache: List[Dict] = []
+        self._cache_offset = 0
+        self._tail = b""          # trailing partial line (no newline yet)
 
     def put(self, record: Dict) -> None:
         line = json.dumps(record)
@@ -70,8 +80,23 @@ class FileStatsStorage(StatsStorage):
 
     def records(self, session_id=None) -> List[Dict]:
         with self._lock:
-            text = self._path.read_text()
-        rs = [json.loads(l) for l in text.splitlines() if l.strip()]
+            size = self._path.stat().st_size
+            if size < self._cache_offset:          # truncated/rotated
+                self._cache, self._cache_offset, self._tail = [], 0, b""
+            if size > self._cache_offset:
+                with open(self._path, "rb") as f:
+                    f.seek(self._cache_offset)
+                    chunk = self._tail + f.read(size - self._cache_offset)
+                lines = chunk.split(b"\n")
+                tail = lines.pop()                 # b"" when chunk ends in \n
+                # parse BEFORE committing any cache state: a corrupt line
+                # must raise on every call, not silently drop the records
+                # that follow it in the same chunk
+                parsed = [json.loads(l) for l in lines if l.strip()]
+                self._cache.extend(parsed)
+                self._cache_offset = size
+                self._tail = tail
+            rs = list(self._cache)
         if session_id is not None:
             rs = [r for r in rs if r.get("session", "default") == session_id]
         return rs

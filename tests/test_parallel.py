@@ -742,3 +742,31 @@ class TestZigzagRing:
         q2 = jnp.zeros((1, 1, 512, 64))  # head_dim unaligned
         with pytest.raises(ValueError, match="flash core"):
             ring_attention_zigzag(q2, q2, q2, mesh.mesh)
+
+
+class TestRingFlashShapeGuard:
+    def test_forced_flash_on_unaligned_shapes_raises(self):
+        """ADVICE r2: impl='flash' on shapes failing _flash_core_ok must be
+        a clear ValueError, not a Mosaic internal error."""
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.parallel import ring_attention
+
+        mesh = DeviceMesh(data=1, seq=8)
+        B, H, T, D = 1, 2, 64, 64          # D % 128 != 0
+        q = jnp.ones((B, H, T, D))
+        with _pytest.raises(ValueError, match="head_dim"):
+            ring_attention(q, q, q, mesh.mesh, impl="flash")
+
+    def test_merge_lse_posinf_guard(self):
+        """A +inf lse (flash kernel's fully-masked-row sentinel) must mean
+        'no contribution', not poison the other side of the merge."""
+        from deeplearning4j_tpu.parallel.sequence import _merge_lse
+
+        o = jnp.ones((1, 1, 4, 8))
+        lse = jnp.zeros((1, 1, 4, 1))
+        o_bad = jnp.full((1, 1, 4, 8), 7.0)
+        lse_bad = jnp.full((1, 1, 4, 1), jnp.inf)
+        merged, lse_new = _merge_lse(o, lse, o_bad, lse_bad)
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(o))
+        np.testing.assert_allclose(np.asarray(lse_new), np.asarray(lse))

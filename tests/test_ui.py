@@ -156,3 +156,42 @@ class TestLiveDashboard:
         for layer, entry in recs[1]["histograms"].items():
             assert entry.get("u") is not None, layer
             assert sum(entry["u"]["counts"]) > 0
+
+
+class TestFileStorageIncrementalCache:
+    """r3: records() parses only appended bytes per call (ADVICE: the /data
+    poll must not re-read the whole history every 2 seconds)."""
+
+    def test_incremental_and_truncation(self, tmp_path):
+        import json
+
+        from deeplearning4j_tpu.ui.storage import FileStatsStorage
+
+        st = FileStatsStorage(tmp_path / "s.jsonl")
+        for i in range(5):
+            st.put({"iteration": i, "score": float(i)})
+        assert len(st.records()) == 5
+        # append more; only the tail should be parsed (cache grows)
+        for i in range(5, 8):
+            st.put({"iteration": i, "score": float(i)})
+        rs = st.records()
+        assert [r["iteration"] for r in rs] == list(range(8))
+        # a SECOND reader over the same file sees everything too
+        st2 = FileStatsStorage(tmp_path / "s.jsonl")
+        assert len(st2.records()) == 8
+        # external truncation invalidates the cache
+        (tmp_path / "s.jsonl").write_text(
+            json.dumps({"iteration": 0, "score": 9.0}) + "\n")
+        assert [r["score"] for r in st.records()] == [9.0]
+
+    def test_partial_trailing_line_not_parsed(self, tmp_path):
+        from deeplearning4j_tpu.ui.storage import FileStatsStorage
+
+        st = FileStatsStorage(tmp_path / "s.jsonl")
+        st.put({"iteration": 0, "score": 1.0})
+        with open(tmp_path / "s.jsonl", "a") as f:
+            f.write('{"iteration": 1, "sco')   # writer mid-line
+        assert len(st.records()) == 1
+        with open(tmp_path / "s.jsonl", "a") as f:
+            f.write('re": 2.0}\n')
+        assert [r["iteration"] for r in st.records()] == [0, 1]
