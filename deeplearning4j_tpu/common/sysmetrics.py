@@ -1,0 +1,65 @@
+"""Host + device system metrics for the monitoring path.
+
+Reference analog: the system/memory section of the reference UI
+(StatsListener collects JVM/off-heap memory and GC counts via
+SystemInfoCollection) and PerformanceListener's GC/memory reporting. The
+TPU-native equivalents are host RSS (the JVM-heap analog) and PJRT device
+memory stats (the device-memory analog, from
+jax.local_devices()[0].memory_stats() when the backend exposes it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+def host_rss_mb() -> float:
+    """Resident set size of this process in MiB (from /proc/self/statm;
+    falls back to resource.getrusage off-Linux)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20)
+    except Exception:
+        try:
+            import resource
+            import sys
+
+            # peak (not current) RSS; ru_maxrss is KiB on Linux, bytes on
+            # macOS — and this branch only runs where /proc is absent
+            div = (1 << 20) if sys.platform == "darwin" else 1024
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
+        except Exception:
+            return 0.0
+
+
+def device_memory_mb(device=None) -> Dict[str, float]:
+    """{'device_mem_in_use_mb', 'device_mem_limit_mb'} when the PJRT
+    backend exposes memory_stats(); {} otherwise (CPU backend, interpret)."""
+    try:
+        import jax
+
+        dev = device or jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if not stats:
+            return {}
+        out = {}
+        if "bytes_in_use" in stats:
+            out["device_mem_in_use_mb"] = stats["bytes_in_use"] / (1 << 20)
+        if "bytes_limit" in stats:
+            out["device_mem_limit_mb"] = stats["bytes_limit"] / (1 << 20)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            out["device_mem_peak_mb"] = peak / (1 << 20)
+        return out
+    except Exception:
+        return {}
+
+
+def system_metrics() -> Dict[str, float]:
+    """All system scalar series for the listener/UI path."""
+    out = {"host_rss_mb": host_rss_mb()}
+    out.update(device_memory_mb())
+    return out

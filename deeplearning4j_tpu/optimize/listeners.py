@@ -48,16 +48,21 @@ class CollectScoresListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """Iterations/sec + samples/sec (PerformanceListener)."""
+    """Iterations/sec + samples/sec + system metrics (PerformanceListener —
+    the reference reports iter/sec alongside JVM/GC memory; here the
+    analogs are host RSS and PJRT device memory)."""
 
-    def __init__(self, frequency: int = 10, log: Callable[[str], None] = print):
+    def __init__(self, frequency: int = 10, log: Callable[[str], None] = print,
+                 report_system: bool = True):
         self.frequency = max(1, frequency)
         self.log = log
+        self.report_system = report_system
         self._t0: Optional[float] = None
         self._iters = 0
         self.batch_size = 0
         self.last_iters_per_sec = 0.0
         self.last_samples_per_sec = 0.0
+        self.last_system: dict = {}
 
     def iteration_done(self, model, iteration, epoch, score):
         now = time.perf_counter()
@@ -70,10 +75,19 @@ class PerformanceListener(TrainingListener):
             dt = now - self._t0
             self.last_iters_per_sec = self._iters / dt
             self.last_samples_per_sec = self.last_iters_per_sec * self.batch_size
-            self.log(
+            msg = (
                 f"iter {iteration}: {self.last_iters_per_sec:.2f} it/s"
                 + (f", {self.last_samples_per_sec:.1f} samples/s" if self.batch_size else "")
             )
+            if self.report_system:
+                from deeplearning4j_tpu.common.sysmetrics import system_metrics
+
+                self.last_system = system_metrics()
+                msg += f", rss {self.last_system.get('host_rss_mb', 0):.0f}MB"
+                dev = self.last_system.get("device_mem_in_use_mb")
+                if dev is not None:
+                    msg += f", device {dev:.0f}MB"
+            self.log(msg)
             self._t0 = now
             self._iters = 0
 

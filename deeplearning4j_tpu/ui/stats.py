@@ -74,11 +74,15 @@ class StatsListener(TrainingListener):
 
     def __init__(self, storage: StatsStorage, session_id: str = "default",
                  update_frequency: int = 10, collect_param_stats: bool = True,
-                 collect_histograms: bool = True):
+                 collect_histograms: bool = True,
+                 collect_system_stats: bool = True):
         self.storage = storage
         self.session_id = session_id
         self.update_frequency = max(1, update_frequency)
         self.collect_param_stats = collect_param_stats
+        # host RSS + device memory scalar series (the reference UI's
+        # system page)
+        self.collect_system_stats = collect_system_stats
         # per-layer weight + update histograms (the reference UI's model
         # page): updates are param DELTAS between successive samples — the
         # same quantity the reference charts as "updates" (lr*gradient
@@ -98,9 +102,16 @@ class StatsListener(TrainingListener):
             "timestamp": time.time(),
         }
         if self._last_time is not None:
-            rec["iteration_time_ms"] = (now - self._last_time) * 1e3
+            dt = now - self._last_time
+            rec["iteration_time_ms"] = dt * 1e3
+            if dt > 0:
+                rec["iterations_per_sec"] = 1.0 / dt
         self._last_time = now
         if iteration % self.update_frequency == 0:
+            if self.collect_system_stats:
+                from deeplearning4j_tpu.common.sysmetrics import system_metrics
+
+                rec.update(system_metrics())
             if self.collect_param_stats:
                 rec.update(_tree_stats(model.params, "params"))
             if self.collect_histograms:

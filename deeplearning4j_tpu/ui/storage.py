@@ -78,22 +78,34 @@ class FileStatsStorage(StatsStorage):
             with open(self._path, "a") as f:
                 f.write(line + "\n")
 
+    def _read_from(self, offset: int, size: int):
+        """Parse records in [offset, size); returns (records, new_tail).
+        Raises on a complete-but-invalid JSON line."""
+        with open(self._path, "rb") as f:
+            f.seek(offset)
+            chunk = (self._tail if offset == self._cache_offset else b"") \
+                + f.read(size - offset)
+        lines = chunk.split(b"\n")
+        tail = lines.pop()                         # b"" when chunk ends in \n
+        return [json.loads(l) for l in lines if l.strip()], tail
+
     def records(self, session_id=None) -> List[Dict]:
         with self._lock:
             size = self._path.stat().st_size
             if size < self._cache_offset:          # truncated/rotated
                 self._cache, self._cache_offset, self._tail = [], 0, b""
             if size > self._cache_offset:
-                with open(self._path, "rb") as f:
-                    f.seek(self._cache_offset)
-                    chunk = self._tail + f.read(size - self._cache_offset)
-                lines = chunk.split(b"\n")
-                tail = lines.pop()                 # b"" when chunk ends in \n
-                # parse BEFORE committing any cache state: a corrupt line
-                # must raise on every call, not silently drop the records
-                # that follow it in the same chunk
-                parsed = [json.loads(l) for l in lines if l.strip()]
-                self._cache.extend(parsed)
+                try:
+                    parsed, tail = self._read_from(self._cache_offset, size)
+                    self._cache.extend(parsed)
+                except ValueError:
+                    # offset landed mid-record: the file was externally
+                    # REWRITTEN to an equal-or-larger size. Recover with one
+                    # full re-read; a genuinely corrupt file still raises
+                    # here (no silent record drops).
+                    self._cache, self._tail = [], b""
+                    parsed, tail = self._read_from(0, size)
+                    self._cache = parsed
                 self._cache_offset = size
                 self._tail = tail
             rs = list(self._cache)

@@ -195,3 +195,70 @@ class TestFileStorageIncrementalCache:
         with open(tmp_path / "s.jsonl", "a") as f:
             f.write('re": 2.0}\n')
         assert [r["iteration"] for r in st.records()] == [0, 1]
+
+
+class TestSystemMetrics:
+    """r3 (VERDICT #9): host RSS / device memory / iter-sec in the
+    listener -> storage -> /data path (the reference UI's system page)."""
+
+    def test_sysmetrics_host_rss(self):
+        from deeplearning4j_tpu.common.sysmetrics import system_metrics
+
+        m = system_metrics()
+        assert m["host_rss_mb"] > 10.0     # a JAX process is > 10 MiB
+
+    def test_stats_listener_records_system_series(self):
+        storage = InMemoryStatsStorage()
+        _train(storage)
+        recs = storage.records("s1")
+        sampled = [r for r in recs if "host_rss_mb" in r]
+        assert sampled, "no system-metric records collected"
+        assert all(r["host_rss_mb"] > 0 for r in sampled)
+        timed = [r for r in recs if "iterations_per_sec" in r]
+        assert timed and all(r["iterations_per_sec"] > 0 for r in timed)
+
+    def test_data_endpoint_serves_system_series(self):
+        import json
+
+        from deeplearning4j_tpu.ui.server import collect_data
+
+        storage = InMemoryStatsStorage()
+        _train(storage)
+        payload = collect_data([storage])
+        series = payload["sessions"]["s1"]["series"]
+        assert "host_rss_mb" in series and len(series["host_rss_mb"]) >= 2
+        assert "iterations_per_sec" in series
+        json.dumps(payload)                 # JSON-serializable end to end
+
+    def test_performance_listener_reports_system(self):
+        from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+
+        lines = []
+        pl_ = PerformanceListener(frequency=2, log=lines.append)
+        pl_.batch_size = 16
+        for i in range(5):
+            pl_.iteration_done(None, i, 0, 0.5)
+        assert lines and "rss" in lines[-1]
+        assert pl_.last_system.get("host_rss_mb", 0) > 0
+
+
+class TestFileStorageRewriteRecovery:
+    def test_equal_or_larger_external_rewrite_recovers(self, tmp_path):
+        """An external rewrite to >= the cached size must trigger a full
+        re-read, not a permanent JSONDecodeError on every poll."""
+        import json
+
+        from deeplearning4j_tpu.ui.storage import FileStatsStorage
+
+        st = FileStatsStorage(tmp_path / "s.jsonl")
+        st.put({"iteration": 0, "score": 1.0})
+        assert len(st.records()) == 1
+        # rewrite with LONGER content (size grows -> offset lands mid-record)
+        (tmp_path / "s.jsonl").write_text(
+            json.dumps({"iteration": 0, "score": 5.0, "extra": "x" * 50})
+            + "\n" + json.dumps({"iteration": 1, "score": 6.0}) + "\n")
+        rs = st.records()
+        assert [r["score"] for r in rs] == [5.0, 6.0]
+        # and subsequent appends keep working incrementally
+        st.put({"iteration": 2, "score": 7.0})
+        assert [r["score"] for r in st.records()] == [5.0, 6.0, 7.0]
