@@ -26,7 +26,8 @@ from deeplearning4j_tpu.parallel.distributed import (
     FaultTolerantTrainer, initialize_distributed,
 )
 from deeplearning4j_tpu.parallel.sequence import (
-    ring_attention, sequence_parallel_encoder, ulysses_attention,
+    ring_attention, ring_attention_zigzag, sequence_parallel_encoder,
+    ulysses_attention, zigzag_shard, zigzag_unshard,
 )
 from deeplearning4j_tpu.parallel.compression import (
     EncodedGradientTrainer, message_density, threshold_encode,
@@ -38,5 +39,6 @@ __all__ = ["DeviceMesh", "multi_slice_mesh", "ParameterAveragingTrainer", "Paral
            "switch_moe", "FaultTolerantTrainer", "initialize_distributed",
            "SparkDl4jMultiLayer", "SparkComputationGraph",
            "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
-           "ring_attention", "ulysses_attention", "sequence_parallel_encoder",
+           "ring_attention", "ring_attention_zigzag", "ulysses_attention",
+           "sequence_parallel_encoder", "zigzag_shard", "zigzag_unshard",
            "EncodedGradientTrainer", "threshold_encode", "message_density"]

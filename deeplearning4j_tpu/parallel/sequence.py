@@ -309,18 +309,37 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
     MultiLayerConfiguration.tBPTTLength — SURVEY.md §5).
 
     x: [B, T, D] with T divisible by the axis size. Returns [B, T, D].
+
+    impl="zigzag" (causal only) uses the load-balanced zig-zag ring core
+    and runs ENTIRELY in the permuted domain: pass x already permuted with
+    ``zigzag_shard(x, mesh, seq_axis=1)`` (done ONCE per run, together with
+    labels/masks); the output comes back zig-zag-permuted too. All
+    per-token math in the block is order-agnostic, so stacking layers and
+    computing per-token losses needs no unpermute — that is the "at scale"
+    path with zero per-step gathers.
     """
     from deeplearning4j_tpu.nn.layers.base import resolve_activation
 
     act = resolve_activation(activation)
     if impl == "ulysses":
         _ulysses_causal_guard(n_heads, mesh, axis)
+    elif impl == "zigzag":
+        if not causal:
+            raise ValueError("impl='zigzag' is the load-balanced CAUSAL "
+                             "ring; use impl='ring' for non-causal")
+        _zigzag_guard(x.shape[1], mesh.shape[axis], x.shape[-1] // n_heads)
     elif impl != "ring":
-        raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+        raise ValueError(
+            f"impl must be 'ring', 'zigzag' or 'ulysses', got {impl!r}")
     # decided here (not in the traced body) so check_vma below can match
     if impl == "ring":
         _ring_local, _check_vma = _select_ring_core(
             x.shape[-1] // n_heads, x.shape[1] // mesh.shape[axis])
+    elif impl == "zigzag":
+        def _ring_local(q, k, v, *, axis, causal, scale):
+            return _ring_zigzag_local(q, k, v, axis=axis, scale=scale)
+
+        _check_vma = False
     else:
         _ring_local, _check_vma = None, True
 
@@ -381,6 +400,8 @@ def sequence_parallel_encoder(params, x, mesh, *, n_heads: int,
 def zigzag_permutation(T: int, n: int):
     """(perm, inverse): sequence index permutation placing stripes
     [i, 2n-1-i] on device i. T must divide into 2n stripes."""
+    if T % (2 * n):
+        raise ValueError(f"zigzag needs T ({T}) divisible by 2*{n} stripes")
     S = T // (2 * n)
     order = []
     for i in range(n):
@@ -532,25 +553,60 @@ def _ring_zigzag_local(q, k, v, *, axis, scale, block_q=512, block_k=1024):
                         min(block_k, k.shape[2] // 2))
 
 
+def zigzag_shard(x, mesh, *, seq_axis: int, axis: str = "seq"):
+    """Apply the zig-zag stripe permutation along ``seq_axis`` ONCE.
+
+    ``seq_axis`` is intentionally required: the permutation silently
+    "succeeds" on any axis whose length divides into 2n stripes, so a
+    defaulted axis on a [B, T, D] vs [B, H, T, D] layout mix-up would
+    corrupt data instead of erroring (2 for q/k/v, 1 for encoder inputs).
+
+    The at-scale usage of the balanced causal ring: permute inputs (and
+    anything position-aligned with them — labels, masks, position ids) one
+    time up front, run N train steps / N layers on permuted data via
+    ``ring_attention_zigzag(pre_permuted=True)`` or
+    ``sequence_parallel_encoder(impl="zigzag")``, and ``zigzag_unshard``
+    only what leaves the permuted domain. One O(T) gather per RUN instead
+    of three gathers + one scatter per CALL. Position-wise computations
+    (LN, projections, MLP, per-token losses) are order-agnostic, so entire
+    transformer stacks run inside the permuted domain unchanged."""
+    n = mesh.shape[axis]
+    perm, _ = zigzag_permutation(x.shape[seq_axis], n)
+    return jnp.take(x, perm, axis=seq_axis)
+
+
+def zigzag_unshard(x, mesh, *, seq_axis: int, axis: str = "seq"):
+    """Inverse of zigzag_shard (restore natural sequence order)."""
+    n = mesh.shape[axis]
+    _, inv = zigzag_permutation(x.shape[seq_axis], n)
+    return jnp.take(x, inv, axis=seq_axis)
+
+
+def _zigzag_guard(T, n, head_dim):
+    if T % (2 * n):
+        raise ValueError(f"zigzag needs T ({T}) divisible by 2*{n} stripes")
+    if not _flash_core_ok(head_dim, T // (2 * n)):
+        raise ValueError("zigzag ring runs on the flash core: needs "
+                         "head_dim % 128 == 0 and stripe length % 8 == 0")
+
+
 def ring_attention_zigzag(q, k, v, mesh, *, axis: str = "seq",
-                          scale: float | None = None):
+                          scale: float | None = None,
+                          pre_permuted: bool = False):
     """Load-balanced CAUSAL ring attention (zig-zag stripe sharding).
 
-    Takes/returns NORMAL sequence order ([B, H, T, D]); the stripe
-    permutation is applied internally. At scale, pre-permute the data once
-    and call the local core inside your own shard_map instead to avoid the
-    per-call gather. Requires T % (2 * mesh axis size) == 0 and the flash
-    kernel's alignment (head_dim % 128 == 0)."""
+    By default takes/returns NORMAL sequence order ([B, H, T, D]) and
+    applies the stripe permutation internally (one gather per operand per
+    call). At scale, permute once with ``zigzag_shard`` and pass
+    ``pre_permuted=True``: inputs are then consumed — and the output
+    returned — in zig-zag order with no per-call permutation at all.
+    Requires T % (2 * mesh axis size) == 0 and the flash kernel's alignment
+    (head_dim % 128 == 0)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     n = mesh.shape[axis]
     T = q.shape[2]
-    if T % (2 * n):
-        raise ValueError(f"zigzag needs T ({T}) divisible by 2*{n} stripes")
-    if not _flash_core_ok(q.shape[-1], T // (2 * n)):
-        raise ValueError("zigzag ring runs on the flash core: needs "
-                         "head_dim % 128 == 0 and stripe length % 8 == 0")
-    perm, inv = zigzag_permutation(T, n)
+    _zigzag_guard(T, n, q.shape[-1])
     fn = shard_map(
         functools.partial(_ring_zigzag_local, axis=axis, scale=scale),
         mesh=mesh,
@@ -558,6 +614,9 @@ def ring_attention_zigzag(q, k, v, mesh, *, axis: str = "seq",
         out_specs=P(None, None, axis, None),
         check_vma=False,  # pallas interpret-mode VMA limitation (see above)
     )
+    if pre_permuted:
+        return fn(q, k, v)
+    perm, inv = zigzag_permutation(T, n)
     out = fn(jnp.take(q, perm, axis=2), jnp.take(k, perm, axis=2),
              jnp.take(v, perm, axis=2))
     return jnp.take(out, inv, axis=2)

@@ -60,3 +60,10 @@ def test_imagenet_pipeline():
 
     loss = imagenet_pipeline.main(n=32, stored=36, crop=32, batch=8, epochs=1)
     assert np.isfinite(float(loss))
+
+
+def test_long_context_zigzag():
+    import long_context_zigzag
+
+    losses = long_context_zigzag.main(T=128, d_model=128, n_heads=1, steps=3)
+    assert losses[-1] < losses[0]
