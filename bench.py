@@ -390,6 +390,249 @@ def bench_longcontext(T=8192, rounds=3):
     }))
 
 
+def _stats(runs):
+    """{median, iqr: [q1, q3], rounds} — the dispersion fields every mode
+    reports so backend drift is visible in the artifact itself."""
+    s = sorted(runs)
+    n = len(s)
+    med = s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+    q1 = s[max(0, (n - 1) // 4)]
+    q3 = s[min(n - 1, (3 * (n - 1)) // 4)]
+    return {"median": round(med, 2), "iqr": [round(q1, 2), round(q3, 2)],
+            "rounds": n}
+
+
+# --------------------------------------------------------------------------
+# per-kernel on-chip A/B (VERDICT r2 #2): each Pallas kernel vs its plain-XLA
+# lowering, measured with DEVICE-side loops — per-dispatch tunnel latency
+# (~3.5ms on axon) otherwise floors every small-shape measurement.
+# --------------------------------------------------------------------------
+
+
+def _device_loop_ab(build_kernel, build_xla, *, iters=30, rounds=3):
+    """Interleaved A/B of two jitted scalar-returning step fns, each executed
+    inside ONE jit via fori_loop (dependent chain) with a DYNAMIC trip
+    count, timed by the two-point method: step_ms = (t(2n) - t(n)) / n.
+    The difference cancels every fixed cost — jit dispatch, the ~100ms+
+    tunnel RPC of the host-fetch barrier — exactly; a single long chain
+    merely amortizes it. Returns per-path ms/step medians over
+    ``rounds`` alternating rounds."""
+    import jax
+    import jax.numpy as jnp
+
+    def looped(step):
+        @jax.jit
+        def many(seed, n):
+            def body(i, acc):
+                return step(acc)
+            return jax.lax.fori_loop(0, n, body, seed)
+        return many
+
+    fk, fx = looped(build_kernel()), looped(build_xla())
+    seed = 0.0
+    float(fk(seed, 2))   # compile + warm (host fetch = tunnel-safe barrier)
+    float(fx(seed, 2))
+
+    def one(f):
+        t0 = time.perf_counter()
+        float(f(seed, iters))
+        t1 = time.perf_counter()
+        float(f(seed, 2 * iters))
+        t2 = time.perf_counter()
+        return ((t2 - t1) - (t1 - t0)) / iters * 1e3
+
+    tk, tx = [], []
+    for _ in range(rounds):
+        tk.append(one(fk))
+        tx.append(one(fx))
+    mk = sorted(tk)[len(tk) // 2]
+    mx = sorted(tx)[len(tx) // 2]
+    return {"kernel_ms": round(mk, 3), "xla_ms": round(mx, 3),
+            "speedup": round(mx / mk, 3)}
+
+
+def bench_kernels(rounds=3, budget_deadline=None):
+    """Per-kernel speedup table: flash attention (fwd + train), fused LSTM
+    (fwd + train, in its selected regime AND the demoted multi-tile regime),
+    LRN (AlexNet shape). Each entry records kernel-vs-XLA on this chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.common.env import env
+
+    table = {}
+
+    def over_deadline():
+        return budget_deadline is not None and time.perf_counter() > budget_deadline
+
+    rng = np.random.default_rng(0)
+
+    # ---- flash attention: fwd and train, T=4096 bf16
+    def flash_rows():
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+        from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
+
+        B, H, T, D = 1, 4, 4096, 128
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+
+        # the carry REALLY feeds the input (x + acc*1e-12): acc*0 would be
+        # constant-folded and the whole loop body hoisted out of the
+        # while-loop, timing nothing
+        def fwd(attn):
+            def step(acc):
+                o = attn(q + (acc * 1e-12).astype(jnp.bfloat16), q, q)
+                return o.astype(jnp.float32).mean()
+            return step
+
+        table["flash_attention_fwd_T4096"] = _device_loop_ab(
+            lambda: fwd(lambda *a: flash_attention(*a, causal=True)),
+            lambda: fwd(lambda *a: dot_product_attention(*a, causal=True)),
+            iters=400, rounds=rounds)
+
+        def train(attn):
+            def step(acc):
+                def loss(qq):
+                    return attn(qq, qq, qq).astype(jnp.float32).var()
+                return jax.grad(loss)(
+                    q + (acc * 1e-12).astype(jnp.bfloat16)
+                ).astype(jnp.float32).mean()
+            return step
+
+        table["flash_attention_train_T4096"] = _device_loop_ab(
+            lambda: train(lambda *a: flash_attention(*a, causal=True)),
+            lambda: train(lambda *a: dot_product_attention(*a, causal=True)),
+            iters=250, rounds=rounds)
+
+    # ---- fused LSTM: selected regime (nj==1) and demoted multi-tile regime
+    def lstm_rows():
+        from deeplearning4j_tpu.ops.pallas.fused_lstm import fused_lstm_layer
+        from deeplearning4j_tpu.ops.recurrent import lstm_layer
+
+        def rows(tag, B, T, F, H, iters):
+            # iters scaled so iters*step_time >> tunnel RPC jitter
+            x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+            h0 = jnp.zeros((B, H))
+            W = jnp.asarray(rng.normal(size=(F, 4 * H)).astype(np.float32) * .05)
+            R = jnp.asarray(rng.normal(size=(H, 4 * H)).astype(np.float32) * .05)
+            b = jnp.zeros((4 * H,))
+            p = jnp.asarray(rng.normal(size=(3 * H,)).astype(np.float32) * .05)
+
+            def fwd(fn):
+                def step(acc):
+                    out, _ = fn(x + acc * 1e-12, h0, h0, W, R, b, peephole=p)
+                    return out.mean()
+                return step
+
+            def train(fn):
+                def step(acc):
+                    def loss(WW):
+                        return fn(x, h0, h0, WW, R, b, peephole=p)[0].sum()
+                    return jax.grad(loss)(W + acc * 1e-16).mean()
+                return step
+
+            table[f"fused_lstm_fwd_{tag}"] = _device_loop_ab(
+                lambda: fwd(fused_lstm_layer), lambda: fwd(lstm_layer),
+                iters=iters, rounds=rounds)
+            table[f"fused_lstm_train_{tag}"] = _device_loop_ab(
+                lambda: train(fused_lstm_layer), lambda: train(lstm_layer),
+                iters=iters, rounds=rounds)
+
+        rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
+        if not over_deadline():
+            rows("B256_H1024", 256, 64, 512, 1024, 60)  # demoted (nj>1)
+
+    # ---- LRN, AlexNet conv2 shape. The impl fns are captured at BUILD
+    # time (pallas_lrn directly vs the registered xla lowering) — selecting
+    # through the registry inside the jitted step would read the env flags
+    # at TRACE time, after both builders ran, and silently A/B the xla
+    # path against itself
+    def lrn_rows():
+        from deeplearning4j_tpu.ops.convolution import lrn as xla_lrn
+        from deeplearning4j_tpu.ops.pallas.lrn import pallas_lrn
+
+        x = jnp.asarray(rng.normal(size=(64, 27, 27, 256)).astype(np.float32))
+
+        def build(fn):
+            def mk():
+                def step(acc):
+                    return fn(x + acc * 1e-12, depth=5).mean()
+                return step
+            return mk
+
+        def build_train(fn):
+            def mk():
+                def step(acc):
+                    return jax.grad(
+                        lambda xx: (fn(xx, depth=5) ** 2).sum())(
+                            x + acc * 1e-12).mean()
+                return step
+            return mk
+
+        table["lrn_fwd_alexnet"] = _device_loop_ab(
+            build(pallas_lrn), build(xla_lrn), iters=1200, rounds=rounds)
+        table["lrn_train_alexnet"] = _device_loop_ab(
+            build_train(pallas_lrn), build_train(xla_lrn), iters=400,
+            rounds=rounds)
+
+    for block in (flash_rows, lstm_rows, lrn_rows):
+        if over_deadline():
+            table["truncated"] = "deadline reached; remaining kernels skipped"
+            break
+        try:
+            block()
+        except Exception as e:          # record, never kill the bench line
+            table[f"error_{block.__name__}"] = f"{type(e).__name__}: {e}"
+    return table
+
+
+def bench_pipeline(batch=256, n=2048, hw=256, crop=224, epochs=3):
+    """Standalone sustained throughput of the native image input path
+    (VERDICT r2 #3): staged uint8 [n, hw, hw, 3] -> threaded random-crop /
+    flip / normalize -> float32 [batch, crop, crop, 3] batches. Measured on
+    the bench HOST; the number to compare against the model's samples/sec
+    (the pipeline must sustain at least the model rate to not be the
+    bottleneck)."""
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.native import NativeImageDataSetIterator
+    from deeplearning4j_tpu.native.pipeline import write_image_dataset
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (n, hw, hw, 3), dtype=np.uint8)
+    labels = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, n)]
+    threads = max(4, (os.cpu_count() or 4) - 1)
+    out = {"batch": batch, "shape": f"{hw}x{hw}x3->crop{crop}",
+           "threads": threads}
+    with tempfile.TemporaryDirectory() as d:
+        img_path, label_path = write_image_dataset(d, imgs, labels)
+        # f32: host-side normalize (DataVec behavior); u8: crop/flip only,
+        # normalize on DEVICE (the shipping imagenet path — 4x less host
+        # traffic, XLA fuses the affine into the first conv)
+        for output in ("f32", "u8"):
+            it = NativeImageDataSetIterator(
+                img_path, label_path, n, (hw, hw, 3), 1000, batch,
+                crop=(crop, crop), shuffle=True, augment=True,
+                n_threads=threads, queue_cap=8, output=output)
+            out["native"] = it.native
+            rates = []
+            for e in range(epochs):
+                t0 = time.perf_counter()
+                seen = 0
+                for ds in it:
+                    seen += ds.features.shape[0]
+                dt = time.perf_counter() - t0
+                if e > 0:                # epoch 0 warms the worker threads
+                    rates.append(seen / dt)
+                it.reset()
+            it.close()
+            out[f"samples_per_sec_{output}"] = _stats(rates)
+    out["samples_per_sec"] = out["samples_per_sec_u8"]
+    return out
+
+
 def main():
     _enable_compile_cache()
     # argv: [mode] [batch] — a bare number is a resnet50 batch (back-compat)
@@ -400,39 +643,82 @@ def main():
         else:
             mode = a
     rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    deadline = time.perf_counter() + float(
+        os.environ.get("BENCH_DEADLINE_SECS", "520"))
 
     if mode == "longcontext":
         bench_longcontext(T=batch or 8192, rounds=rounds)
         return
+    if mode == "pipeline":
+        out = bench_pipeline(batch=batch or 256)
+        print(json.dumps({
+            "metric": "native image input pipeline sustained throughput "
+                      "(host, %s, batch %d)" % (out["shape"], out["batch"]),
+            "value": out["samples_per_sec"]["median"],
+            "unit": "samples/sec",
+            "vs_baseline": None,
+            "dispersion": out["samples_per_sec"],
+            "native": out["native"],
+            "threads": out["threads"],
+        }))
+        return
+    if mode == "kernels":
+        table = bench_kernels(rounds=rounds, budget_deadline=deadline)
+        speedups = [v["speedup"] for v in table.values()
+                    if isinstance(v, dict) and "speedup" in v]
+        gm = 1.0
+        for s in speedups:
+            gm *= s
+        gm = gm ** (1.0 / max(1, len(speedups)))
+        print(json.dumps({
+            "metric": "Pallas kernel vs plain-XLA speedup table "
+                      "(geometric mean of %d entries)" % len(speedups),
+            "value": round(gm, 4),
+            "unit": "x",
+            "vs_baseline": None,
+            "kernels": table,
+        }))
+        return
     if mode != "resnet50":
         defaults = {"lenet": 512, "lstm": 64, "bert": 32}
         if mode not in defaults:
-            raise SystemExit(f"unknown bench mode '{mode}' "
-                             f"(expected resnet50|lenet|lstm|bert|longcontext)")
+            raise SystemExit(
+                f"unknown bench mode '{mode}' (expected resnet50|lenet|lstm|"
+                f"bert|longcontext|pipeline|kernels)")
         batch = batch or defaults[mode]
         fn, label = make_mode(mode, batch)
-        runs = sorted(fn() for _ in range(rounds))
+        runs = [fn() for _ in range(rounds)]
+        # a SECOND measurement block in the same artifact: protocol drift
+        # (the r1->r2 LSTM 3x mystery) becomes visible per-run, not
+        # per-round
+        runs2 = [fn() for _ in range(rounds)]
+        st1, st2 = _stats(runs), _stats(runs2)
         print(json.dumps({
             "metric": "%s (zoo entrypoint, batch %d, median of %d rounds)"
                       % (label, batch, rounds),
-            "value": round(runs[len(runs) // 2], 2),
+            "value": st1["median"],
             "unit": "samples/sec/chip",
             "vs_baseline": None,
+            "dispersion": st1,
+            "remeasure": st2,
         }))
         return
     batch = batch or 256
 
-    def run_rounds(b):
+    def run_rounds(b, fns=None):
         # Shared tunneled backends drift +/-30% over minutes; interleave A/B
         # rounds and report the median throughput and median per-round ratio.
-        ours_fn = make_ours(b)
-        # AOT-compile once up front; with the persistent cache enabled the
-        # timed jit path below reuses this XLA compile instead of repeating it
-        ours_fn.flops_per_step()
-        try:
-            flax_fn = make_flax_reference(b)
-        except Exception:
-            flax_fn = None
+        if fns is None:
+            ours_fn = make_ours(b)
+            # AOT-compile once up front; with the persistent cache enabled the
+            # timed jit path below reuses this compile instead of repeating it
+            ours_fn.flops_per_step()
+            try:
+                flax_fn = make_flax_reference(b)
+            except Exception:
+                flax_fn = None
+        else:
+            ours_fn, flax_fn = fns
         ours_runs, ratios = [], []
         for _ in range(rounds):
             o = ours_fn()
@@ -444,7 +730,7 @@ def main():
                     flax_fn = None  # keep reporting ours even if ref dies
         med = sorted(ours_runs)[len(ours_runs) // 2]
         vs = sorted(ratios)[len(ratios) // 2] if ratios else None
-        return med, vs, ours_fn
+        return med, vs, ours_fn, (ours_runs, ratios, flax_fn)
 
     def peak_flops():
         import jax
@@ -458,10 +744,10 @@ def main():
         return None  # unknown device: report mfu=null, not a guess
 
     try:
-        med, vs, ours_fn = run_rounds(batch)
+        med, vs, ours_fn, extra = run_rounds(batch)
     except Exception:  # OOM during compile/execute: retry at half batch
         batch = batch // 2
-        med, vs, ours_fn = run_rounds(batch)
+        med, vs, ours_fn, extra = run_rounds(batch)
 
     # MFU: XLA-counted flops/step x steps/sec over chip peak (the BASELINE
     # metric is samples/sec/chip + MFU)
@@ -473,13 +759,42 @@ def main():
             mfu = flops * (med / batch) / peak
     except Exception:
         mfu = None
-    print(json.dumps({
+    result = {
         "metric": "ResNet-50 ImageNet train throughput (zoo entrypoint, bf16, batch %d, median of %d interleaved rounds)" % (batch, rounds),
         "value": round(med, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": None if vs is None else round(vs, 4),
         "mfu": None if mfu is None else round(mfu, 4),
-    }))
+        "dispersion": _stats(extra[0]),
+    }
+    # optional blocks, each within the bench deadline so the driver's
+    # timeout can never lose the north-star line
+    if time.perf_counter() < deadline - 60:
+        try:    # remeasure with the SAME compiled fns: drift is visible
+            med2, vs2, _, extra2 = run_rounds(batch, fns=(ours_fn, extra[2]))
+            result["remeasure"] = dict(_stats(extra2[0]),
+                                       vs_baseline=None if vs2 is None
+                                       else round(vs2, 4))
+        except Exception:
+            pass
+    if time.perf_counter() < deadline - 30:
+        try:    # the input path next to the model rate (host-side)
+            pipe = bench_pipeline(batch=batch, n=1024, epochs=2)
+            result["input_pipeline"] = {
+                "samples_per_sec": pipe["samples_per_sec"]["median"],
+                "native": pipe["native"],
+                "covers_model_rate":
+                    pipe["samples_per_sec"]["median"] >= med,
+            }
+        except Exception:
+            pass
+    if time.perf_counter() < deadline - 120:
+        try:    # per-kernel speedup table (VERDICT r2 #2)
+            result["kernels"] = bench_kernels(rounds=rounds,
+                                              budget_deadline=deadline)
+        except Exception:
+            pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -12,8 +12,14 @@ from deeplearning4j_tpu.native.lib import (
     load_native_lib, native_available, native_csv_parse, trim_compile_cache,
 )
 from deeplearning4j_tpu.native.workspace import Workspace
-from deeplearning4j_tpu.native.pipeline import NativeDataSetIterator, write_binary_dataset
+from deeplearning4j_tpu.native.pipeline import (
+    NativeDataSetIterator, NativeImageDataSetIterator, decode_image_file,
+    image_files_iterator, probe_image, stage_image_files,
+    write_binary_dataset, write_image_dataset,
+)
 
 __all__ = ["load_native_lib", "native_available", "Workspace",
-           "NativeDataSetIterator", "write_binary_dataset",
-           "native_csv_parse", "trim_compile_cache"]
+           "NativeDataSetIterator", "NativeImageDataSetIterator",
+           "write_binary_dataset", "write_image_dataset",
+           "decode_image_file", "image_files_iterator", "probe_image",
+           "stage_image_files", "native_csv_parse", "trim_compile_cache"]

@@ -20,18 +20,26 @@ _tried = False
 
 def _build() -> bool:
     _SO.parent.mkdir(parents=True, exist_ok=True)
-    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-           "-shared", "-o", str(_SO), str(_SRC)]
-    try:
-        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-    except (FileNotFoundError, subprocess.TimeoutExpired):
-        return False
-    if res.returncode != 0:
-        import warnings
+    base = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+            "-shared", "-o", str(_SO), str(_SRC)]
+    # preferred: with the native JPEG/PNG decode front; fall back to a
+    # codec-less build on hosts without libjpeg/libpng dev files (the
+    # Python layer then decodes via PIL)
+    attempts = [base + ["-DDL4J_WITH_CODECS", "-ljpeg", "-lpng"], base]
+    err = ""
+    for cmd in attempts:
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False
+        if res.returncode == 0:
+            return True
+        err = res.stderr
+    import warnings
 
-        warnings.warn(f"native build failed:\n{res.stderr[-2000:]}")
-        return False
-    return True
+    warnings.warn(f"native build failed:\n{err[-2000:]}")
+    return False
 
 
 def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -68,10 +76,13 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
                                         c.c_long, c.c_int, c.c_int, c.c_uint,
                                         c.POINTER(c.c_float),
                                         c.POINTER(c.c_float), c.c_int,
-                                        c.c_int]
+                                        c.c_int, c.c_int]
     lib.dl4j_imgpipe_next.restype = c.c_int
     lib.dl4j_imgpipe_next.argtypes = [c.c_void_p, c.POINTER(c.c_float),
                                       c.POINTER(c.c_float)]
+    lib.dl4j_imgpipe_next_u8.restype = c.c_int
+    lib.dl4j_imgpipe_next_u8.argtypes = [c.c_void_p, c.POINTER(c.c_uint8),
+                                         c.POINTER(c.c_float)]
     lib.dl4j_imgpipe_reset.argtypes = [c.c_void_p]
     lib.dl4j_imgpipe_batches_per_epoch.restype = c.c_long
     lib.dl4j_imgpipe_batches_per_epoch.argtypes = [c.c_void_p]
@@ -90,6 +101,19 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
 
     lib.dl4j_cache_trim.restype = c.c_long
     lib.dl4j_cache_trim.argtypes = [c.c_char_p, c.c_long]
+
+    if hasattr(lib, "dl4j_image_decode"):     # codec build present
+        lib.dl4j_image_probe.restype = c.c_int
+        lib.dl4j_image_probe.argtypes = [c.c_char_p, c.POINTER(c.c_long),
+                                         c.POINTER(c.c_long)]
+        lib.dl4j_image_decode.restype = c.c_int
+        lib.dl4j_image_decode.argtypes = [c.c_char_p,
+                                          c.POINTER(c.c_uint8), c.c_long,
+                                          c.c_long, c.c_long]
+        lib.dl4j_image_stage.restype = c.c_int
+        lib.dl4j_image_stage.argtypes = [c.c_char_p, c.c_long, c.c_char_p,
+                                         c.c_long, c.c_long, c.c_long,
+                                         c.c_int]
     return lib
 
 

@@ -174,9 +174,18 @@ class NativeImageDataSetIterator:
                  label_dim: int, batch_size: int, crop=None,
                  shuffle: bool = True, augment: bool = True, seed: int = 0,
                  mean=None, std=None, n_threads: int = 4, queue_cap: int = 4,
-                 device_prefetch: bool = False):
+                 device_prefetch: bool = False, output: str = "f32"):
+        """``output``: "f32" — workers normalize on the host (the DataVec
+        ImagePreProcessingScaler behavior); "u8" — workers only crop/flip
+        and batches stay uint8 (4x less host traffic AND host->device
+        transfer), with ``normalize()`` (a one-op jit XLA fuses into the
+        consuming conv) applying (x/255 - mean)/std ON DEVICE — the
+        TPU-first split of the same work."""
         H, W, C = image_shape
         crop_h, crop_w = crop if crop is not None else (H, W)
+        if output not in ("f32", "u8"):
+            raise ValueError(f"output must be 'f32' or 'u8', got {output!r}")
+        self.output = output
         self.batch_size = batch_size
         self.out_shape = (batch_size, crop_h, crop_w, C)
         self.label_dim = label_dim
@@ -186,6 +195,7 @@ class NativeImageDataSetIterator:
         std = np.asarray(std if std is not None else [1.0] * C, np.float32)
         if mean.size != C or std.size != C:
             raise ValueError(f"mean/std must have {C} channel entries")
+        self.mean, self.std = mean, std
         self._lib = load_native_lib()
         self._handle = None
         self._py = None
@@ -197,14 +207,27 @@ class NativeImageDataSetIterator:
                 int(augment), seed,
                 mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                 std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                n_threads, queue_cap)
+                n_threads, queue_cap, int(output == "u8"))
         if self._handle is None:
             self._py = _PyImagePipeline(img_path, label_path, n, (H, W, C),
                                         label_dim, (crop_h, crop_w),
                                         batch_size, shuffle, augment, seed,
-                                        mean, std)
-        self._feat_buf = np.empty(self.out_shape, np.float32)
+                                        mean, std, u8=(output == "u8"))
         self._label_buf = np.empty((batch_size, label_dim), np.float32)
+        self._norm_jit = None
+
+    def normalize(self, x):
+        """Device-side (x/255 - mean)/std for output="u8" batches; XLA
+        fuses it into the first conv of the consuming train step."""
+        if self._norm_jit is None:
+            import jax
+            import jax.numpy as jnp
+
+            a = jnp.asarray(1.0 / (255.0 * self.std), jnp.float32)
+            b = jnp.asarray(-self.mean / self.std, jnp.float32)
+            self._norm_jit = jax.jit(
+                lambda u8: u8.astype(jnp.float32) * a + b)
+        return self._norm_jit(x)
 
     @property
     def native(self) -> bool:
@@ -216,17 +239,29 @@ class NativeImageDataSetIterator:
         return self._py.n_batches
 
     def _fetch_host(self):
-        """Next (features, labels) as host numpy, or None at epoch end."""
+        """Next (features, labels) as host numpy, or None at epoch end.
+        Writes into FRESH arrays (no reuse-then-copy: the consumer owns the
+        buffers, and one copy per batch is one too many at model rate)."""
         if self._handle is not None:
-            rc = self._lib.dl4j_imgpipe_next(
-                self._handle,
-                self._feat_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if self.output == "u8":
+                feat = np.empty(self.out_shape, np.uint8)
+                rc = self._lib.dl4j_imgpipe_next_u8(
+                    self._handle,
+                    feat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    self._label_buf.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)))
+            else:
+                feat = np.empty(self.out_shape, np.float32)
+                rc = self._lib.dl4j_imgpipe_next(
+                    self._handle,
+                    feat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    self._label_buf.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)))
             if rc == 1:
                 return None
             if rc != 0:
                 raise RuntimeError("native image pipeline failed")
-            return self._feat_buf.copy(), self._label_buf.copy()
+            return feat, self._label_buf.copy()
         return self._py.next()
 
     def _stage(self, host):
@@ -282,8 +317,9 @@ class _PyImagePipeline:
     """Numpy fallback with the same contract (different RNG stream)."""
 
     def __init__(self, img_path, label_path, n, shape, label_dim, crop,
-                 batch, shuffle, augment, seed, mean, std):
+                 batch, shuffle, augment, seed, mean, std, u8=False):
         H, W, C = shape
+        self.u8 = u8
         self.images = np.fromfile(img_path, np.uint8).reshape(n, H, W, C)
         self.labels = np.fromfile(label_path, np.float32).reshape(n, label_dim)
         self.crop = crop
@@ -308,7 +344,8 @@ class _PyImagePipeline:
         ch, cw = self.crop
         H, W = self.images.shape[1:3]
         idx = self._order[self._pos * self.batch:(self._pos + 1) * self.batch]
-        feats = np.empty((self.batch, ch, cw, self.images.shape[3]), np.float32)
+        feats = np.empty((self.batch, ch, cw, self.images.shape[3]),
+                         np.uint8 if self.u8 else np.float32)
         for r, src in enumerate(idx):
             if self.augment:
                 top = self._rng.integers(0, H - ch + 1)
@@ -319,10 +356,122 @@ class _PyImagePipeline:
             img = self.images[src, top:top + ch, left:left + cw]
             if flip:
                 img = img[:, ::-1]
-            feats[r] = (img.astype(np.float32) / 255.0 - self.mean) / self.std
+            if self.u8:
+                feats[r] = img
+            else:
+                feats[r] = (img.astype(np.float32) / 255.0
+                            - self.mean) / self.std
         self._pos += 1
         return feats, self.labels[idx].copy()
 
     def reset(self):
         self.epoch += 1
         self._start()
+
+
+# --------------------------------------------------------------- image files
+# Decode front for the staging format (SURVEY.md §2.3 Datasets/fetchers:
+# DataVec's ImageRecordReader reads actual image FILES). JPEG/PNG entropy
+# decode + bilinear resize run in the native library (libjpeg/libpng,
+# threaded, order-preserving); PIL is the fallback when the native build
+# has no codecs.
+
+
+def probe_image(path) -> Tuple[int, int]:
+    """(height, width) of an image file without a full decode."""
+    lib = load_native_lib()
+    if lib is not None and hasattr(lib, "dl4j_image_probe"):
+        h = ctypes.c_long()
+        w = ctypes.c_long()
+        if lib.dl4j_image_probe(str(path).encode(), ctypes.byref(h),
+                                ctypes.byref(w)) == 0:
+            return int(h.value), int(w.value)
+        raise ValueError(f"cannot decode image: {path}")
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return im.height, im.width
+
+
+def decode_image_file(path, image_shape) -> np.ndarray:
+    """Decode one JPEG/PNG file to uint8 [H, W, C] (C=3 RGB / C=1 gray),
+    bilinear-resized to the staging shape."""
+    H, W, C = image_shape
+    lib = load_native_lib()
+    if lib is not None and hasattr(lib, "dl4j_image_decode"):
+        out = np.empty((H, W, C), np.uint8)
+        rc = lib.dl4j_image_decode(
+            str(path).encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), H, W, C)
+        if rc != 0:
+            raise ValueError(f"cannot decode image: {path}")
+        return out
+    return _pil_decode(path, image_shape)
+
+
+def _pil_decode(path, image_shape) -> np.ndarray:
+    from PIL import Image
+
+    H, W, C = image_shape
+    with Image.open(path) as im:
+        im = im.convert("L" if C == 1 else "RGB")
+        if (im.height, im.width) != (H, W):
+            im = im.resize((W, H), Image.BILINEAR)
+        a = np.asarray(im, np.uint8)
+    return a[..., None] if C == 1 else a
+
+
+def stage_image_files(paths, labels, directory, image_shape,
+                      n_threads: int = 8) -> Tuple[str, str]:
+    """Decode image files ONCE into the uint8 staging pair
+    (images.u8 [n, H, W, C], labels.bin [n, label_dim]) consumed by
+    NativeImageDataSetIterator — epochs then re-crop/flip/normalize from
+    staged uint8 without touching the codecs again."""
+    H, W, C = image_shape
+    paths = [str(p) for p in paths]
+    labels = np.ascontiguousarray(labels, np.float32)
+    if len(paths) != len(labels):
+        raise ValueError(f"{len(paths)} paths vs {len(labels)} labels")
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    img_path = directory / "images.u8"
+    label_path = directory / "labels.bin"
+    lib = load_native_lib()
+    if lib is not None and hasattr(lib, "dl4j_image_stage"):
+        rc = lib.dl4j_image_stage("\n".join(paths).encode(), len(paths),
+                                  str(img_path).encode(), H, W, C, n_threads)
+        if rc > 0:
+            raise ValueError(f"{rc} image file(s) failed to decode")
+        if rc != 0:
+            raise RuntimeError("native image staging failed")
+    else:
+        # stream one decoded image at a time — never the whole dataset
+        with open(img_path, "wb") as f:
+            for p in paths:
+                f.write(_pil_decode(p, image_shape).tobytes())
+    labels.tofile(label_path)
+    return str(img_path), str(label_path)
+
+
+def image_files_iterator(paths, labels, image_shape, label_dim,
+                         batch_size, directory=None, **kwargs
+                         ) -> "NativeImageDataSetIterator":
+    """ImageRecordReader-style entry: image FILES -> staged uint8 ->
+    threaded augment/normalize iterator. ``directory`` keeps the staging
+    pair for reuse across runs (defaults to a temp dir)."""
+    import shutil
+    import tempfile
+
+    own_dir = directory is None
+    directory = directory or tempfile.mkdtemp(prefix="dl4j_imgstage_")
+    try:
+        img_path, label_path = stage_image_files(paths, labels, directory,
+                                                 image_shape)
+        return NativeImageDataSetIterator(img_path, label_path, len(paths),
+                                          image_shape, label_dim, batch_size,
+                                          **kwargs)
+    finally:
+        # the pipeline loads the staging pair into memory at construction;
+        # a temp dir WE created must not leak a dataset-sized file per call
+        if own_dir:
+            shutil.rmtree(directory, ignore_errors=True)

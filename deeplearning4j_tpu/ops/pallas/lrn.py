@@ -89,14 +89,26 @@ def pallas_lrn(x, *, depth=5, alpha=1e-4, beta=0.75, k=2.0,
     return _lrn(x, depth, float(alpha), float(beta), float(k), block_rows)
 
 
-def _lrn_applicable(x, *, depth=5, **kw):
-    # enough pixels to fill row blocks; modest channel count so the [C, C]
-    # band plus a row block fit VMEM comfortably
+def _lrn_requires(x, *, depth=5, **kw):
+    # structural: enough pixels to fill row blocks; modest channel count so
+    # the [C, C] band plus a row block fit VMEM comfortably
     n = 1
     for d in x.shape[:-1]:
         n *= d
     return n >= 2048 and 32 <= x.shape[-1] <= 1024
 
 
+def _lrn_applicable(x, *, depth=5, **kw):
+    """DEMOTED off-by-default (r3, measured, two-point on-chip A/B at the
+    AlexNet conv2 shape [64,27,27,256]): forward-only the kernel wins
+    (0.194 vs 0.236 ms, 1.22x) but the TRAIN step loses 0.45x (1.60 vs
+    0.72 ms) because this kernel's backward recomputes through the XLA
+    lowering — the grad path pays kernel-fwd PLUS a full XLA fwd+bwd.
+    Selection cannot see whether grads will flow, and training is the
+    primary workload, so the default is the XLA path; force with
+    DL4J_TPU_FORCE_PALLAS for inference-only use."""
+    return False
+
+
 register_impl("lrn", platform="pallas", predicate=_lrn_applicable,
-              priority=1)(pallas_lrn)
+              requires=_lrn_requires, priority=1)(pallas_lrn)

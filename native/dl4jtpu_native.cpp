@@ -117,6 +117,7 @@ void dl4j_ws_destroy(void* handle) {
 // is always admitted even when the buffer is at capacity.
 struct Batch {
   std::vector<float> feats;
+  std::vector<uint8_t> feats_u8;  // u8-mode pipelines fill this instead
   std::vector<float> labels;
 };
 
@@ -164,6 +165,24 @@ struct BatchQueueCore {
     cv_produce.notify_all();
     lk.unlock();
     std::memcpy(feat_out, b.feats.data(), b.feats.size() * sizeof(float));
+    std::memcpy(label_out, b.labels.data(), b.labels.size() * sizeof(float));
+    return 0;
+  }
+
+  // u8-mode delivery (device-side normalization): features stay uint8 —
+  // 4x less host memory traffic and host->device transfer than float32
+  int next_u8(uint8_t* feat_out, float* label_out) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_consume.wait(lk, [&] {
+      return next_deliver >= n_batches || buffer.count(next_deliver) > 0;
+    });
+    if (next_deliver >= n_batches) return 1;
+    Batch b = std::move(buffer[next_deliver]);
+    buffer.erase(next_deliver);
+    ++next_deliver;
+    cv_produce.notify_all();
+    lk.unlock();
+    std::memcpy(feat_out, b.feats_u8.data(), b.feats_u8.size());
     std::memcpy(label_out, b.labels.data(), b.labels.size() * sizeof(float));
     return 0;
   }
@@ -312,12 +331,26 @@ struct ImagePipeline {
   std::vector<float> labels;    // [n, label_dim]
   long n, H, W, C, label_dim, crop_h, crop_w, batch;
   bool shuffle;
+  int u8_mode = 0;              // 1: deliver uint8 (device-side normalize)
   int augment;                  // 0: center crop, no flip (eval mode)
   unsigned seed;
   unsigned epoch;
   std::vector<float> mean, stdev;
   std::vector<long> order;
   BatchQueueCore core;
+
+  // per-channel uint8->float32 lookup tables: (v/255 - mean_c) / std_c
+  // precomputed once — the per-pixel work collapses to one table load,
+  // which is what lets a single worker core sustain model-rate throughput
+  std::vector<float> lut;  // [C, 256]
+
+  void build_lut() {
+    lut.resize(static_cast<size_t>(C) * 256);
+    for (long c = 0; c < C; ++c)
+      for (int v = 0; v < 256; ++v)
+        lut[c * 256 + v] =
+            (static_cast<float>(v) / 255.0f - mean[c]) / stdev[c];
+  }
 
   void sample_into(long src, float* dst, std::mt19937_64& rng) {
     long top = (H - crop_h) / 2, left = (W - crop_w) / 2;
@@ -331,18 +364,60 @@ struct ImagePipeline {
     for (long y = 0; y < crop_h; ++y) {
       const uint8_t* row = img + ((top + y) * W + left) * C;
       float* out_row = dst + y * crop_w * C;
+      if (!flip && C == 3) {            // hot path: contiguous sweep
+        const uint8_t* px = row;
+        float* out_px = out_row;
+        const float* l0 = lut.data();
+        const float* l1 = lut.data() + 256;
+        const float* l2 = lut.data() + 512;
+        for (long x = 0; x < crop_w; ++x, px += 3, out_px += 3) {
+          out_px[0] = l0[px[0]];
+          out_px[1] = l1[px[1]];
+          out_px[2] = l2[px[2]];
+        }
+        continue;
+      }
       for (long x = 0; x < crop_w; ++x) {
         long sx = flip ? (crop_w - 1 - x) : x;
         const uint8_t* px = row + sx * C;
         float* out_px = out_row + x * C;
         for (long c = 0; c < C; ++c)
-          out_px[c] = (static_cast<float>(px[c]) / 255.0f - mean[c]) / stdev[c];
+          out_px[c] = lut[c * 256 + px[c]];
+      }
+    }
+  }
+
+  // u8 crop/flip only (normalization deferred to the device, where XLA
+  // fuses (x*a + b) into the consuming conv): row-memcpy hot path
+  void sample_into_u8(long src, uint8_t* dst, std::mt19937_64& rng) {
+    long top = (H - crop_h) / 2, left = (W - crop_w) / 2;
+    bool flip = false;
+    if (augment) {
+      if (H > crop_h) top = static_cast<long>(rng() % (H - crop_h + 1));
+      if (W > crop_w) left = static_cast<long>(rng() % (W - crop_w + 1));
+      flip = (rng() & 1) != 0;
+    }
+    const uint8_t* img = images.data() + src * H * W * C;
+    for (long y = 0; y < crop_h; ++y) {
+      const uint8_t* row = img + ((top + y) * W + left) * C;
+      uint8_t* out_row = dst + y * crop_w * C;
+      if (!flip) {
+        std::memcpy(out_row, row, static_cast<size_t>(crop_w) * C);
+        continue;
+      }
+      for (long x = 0; x < crop_w; ++x) {
+        const uint8_t* px = row + (crop_w - 1 - x) * C;
+        uint8_t* out_px = out_row + x * C;
+        for (long c = 0; c < C; ++c) out_px[c] = px[c];
       }
     }
   }
 
   void fill(long b, Batch& out) {
-    out.feats.resize(static_cast<size_t>(batch) * crop_h * crop_w * C);
+    if (u8_mode)
+      out.feats_u8.resize(static_cast<size_t>(batch) * crop_h * crop_w * C);
+    else
+      out.feats.resize(static_cast<size_t>(batch) * crop_h * crop_w * C);
     out.labels.resize(static_cast<size_t>(batch) * label_dim);
     for (long r = 0; r < batch; ++r) {
       long src = order[b * batch + r];
@@ -350,7 +425,11 @@ struct ImagePipeline {
       // sample) regardless of which worker thread picks the batch up
       std::mt19937_64 rng((static_cast<uint64_t>(seed + epoch) << 32)
                           ^ static_cast<uint64_t>(src * 0x9E3779B97F4A7C15ULL));
-      sample_into(src, out.feats.data() + r * crop_h * crop_w * C, rng);
+      if (u8_mode)
+        sample_into_u8(src, out.feats_u8.data() + r * crop_h * crop_w * C,
+                       rng);
+      else
+        sample_into(src, out.feats.data() + r * crop_h * crop_w * C, rng);
       std::memcpy(out.labels.data() + r * label_dim,
                   labels.data() + src * label_dim, label_dim * sizeof(float));
     }
@@ -363,7 +442,8 @@ void* dl4j_imgpipe_create(const char* img_path, const char* label_path,
                           long n, long H, long W, long C, long label_dim,
                           long crop_h, long crop_w, long batch, int shuffle,
                           int augment, unsigned seed, const float* mean,
-                          const float* stdev, int n_threads, int queue_cap) {
+                          const float* stdev, int n_threads, int queue_cap,
+                          int u8_mode) {
   if (n <= 0 || batch <= 0 || H <= 0 || W <= 0 || C <= 0 || label_dim <= 0 ||
       crop_h <= 0 || crop_w <= 0 || crop_h > H || crop_w > W)
     return nullptr;
@@ -380,6 +460,7 @@ void* dl4j_imgpipe_create(const char* img_path, const char* label_path,
   p->crop_h = crop_h; p->crop_w = crop_w;
   p->batch = batch;
   p->shuffle = shuffle != 0;
+  p->u8_mode = u8_mode;
   p->augment = augment;
   p->seed = seed;
   p->epoch = 0;
@@ -387,6 +468,7 @@ void* dl4j_imgpipe_create(const char* img_path, const char* label_path,
   p->stdev.assign(stdev, stdev + C);
   for (long c = 0; c < C; ++c)
     if (p->stdev[c] == 0.0f) { delete p; return nullptr; }
+  p->build_lut();
   p->core.queue_cap = queue_cap > 0 ? queue_cap : 4;
   p->core.n_threads = n_threads > 0 ? n_threads : 4;
   p->core.n_batches = n / batch;
@@ -400,6 +482,12 @@ int dl4j_imgpipe_next(void* handle, float* feat_out, float* label_out) {
   auto* p = static_cast<ImagePipeline*>(handle);
   if (!p) return -1;
   return p->core.next(feat_out, label_out);
+}
+
+int dl4j_imgpipe_next_u8(void* handle, uint8_t* feat_out, float* label_out) {
+  auto* p = static_cast<ImagePipeline*>(handle);
+  if (!p || !p->u8_mode) return -1;
+  return p->core.next_u8(feat_out, label_out);
 }
 
 void dl4j_imgpipe_reset(void* handle) {
@@ -608,3 +696,232 @@ long dl4j_cache_trim(const char* dir, long cap_bytes) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------- image decode
+// Real image-file decode front for the staging format (SURVEY.md §2.3
+// Datasets/fetchers: DataVec's ImageRecordReader reads actual image files
+// via JavaCPP-OpenCV). Native JPEG (libjpeg) + PNG (libpng) entropy decode
+// with bilinear resize to the staging shape, compiled in when the build
+// host has the codec dev headers (-DDL4J_WITH_CODECS, see native/Makefile
+// and native/lib.py); without them the Python layer falls back to PIL.
+#ifdef DL4J_WITH_CODECS
+
+#include <csetjmp>
+#include <fcntl.h>
+#include <unistd.h>
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  // default handler exit()s the process; longjmp back to the caller instead
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+bool decode_jpeg(FILE* f, std::vector<uint8_t>& px, long& h, long& w,
+                 long want_c, bool header_only) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  if (header_only) {
+    h = cinfo.image_height;
+    w = cinfo.image_width;
+    jpeg_destroy_decompress(&cinfo);
+    return true;
+  }
+  cinfo.out_color_space = want_c == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  h = cinfo.output_height;
+  w = cinfo.output_width;
+  if (cinfo.output_components != want_c) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  px.resize(static_cast<size_t>(h) * w * want_c);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = px.data()
+        + static_cast<size_t>(cinfo.output_scanline) * w * want_c;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+bool decode_png_file(const char* path, std::vector<uint8_t>& px, long& h,
+                     long& w, long want_c, bool header_only) {
+  png_image image;
+  std::memset(&image, 0, sizeof image);
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_file(&image, path)) return false;
+  h = image.height;
+  w = image.width;
+  if (header_only) {
+    png_image_free(&image);
+    return true;
+  }
+  image.format = want_c == 1 ? PNG_FORMAT_GRAY : PNG_FORMAT_RGB;
+  px.resize(PNG_IMAGE_SIZE(image));
+  if (!png_image_finish_read(&image, nullptr, px.data(), 0, nullptr)) {
+    png_image_free(&image);
+    return false;
+  }
+  return true;
+}
+
+// half-pixel-center bilinear (the convention of OpenCV/PIL resize)
+void resize_bilinear_u8(const uint8_t* src, long sh, long sw, long c,
+                        uint8_t* dst, long dh, long dw) {
+  if (sh == dh && sw == dw) {
+    std::memcpy(dst, src, static_cast<size_t>(sh) * sw * c);
+    return;
+  }
+  const float ys = static_cast<float>(sh) / dh;
+  const float xs = static_cast<float>(sw) / dw;
+  for (long y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * ys - 0.5f;
+    if (fy < 0) fy = 0;
+    if (fy > sh - 1) fy = static_cast<float>(sh - 1);
+    long y0 = static_cast<long>(fy);
+    long y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (long x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * xs - 0.5f;
+      if (fx < 0) fx = 0;
+      if (fx > sw - 1) fx = static_cast<float>(sw - 1);
+      long x0 = static_cast<long>(fx);
+      long x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (long ch = 0; ch < c; ++ch) {
+        float v00 = src[(y0 * sw + x0) * c + ch];
+        float v01 = src[(y0 * sw + x1) * c + ch];
+        float v10 = src[(y1 * sw + x0) * c + ch];
+        float v11 = src[(y1 * sw + x1) * c + ch];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * c + ch] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+bool decode_any(const char* path, std::vector<uint8_t>& px, long& h, long& w,
+                long want_c, bool header_only) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  unsigned char magic[8] = {0};
+  size_t got = std::fread(magic, 1, 8, f);
+  std::rewind(f);
+  bool ok = false;
+  if (got >= 2 && magic[0] == 0xFF && magic[1] == 0xD8) {
+    ok = decode_jpeg(f, px, h, w, want_c, header_only);
+    std::fclose(f);
+  } else if (got >= 4 && magic[0] == 0x89 && magic[1] == 'P') {
+    std::fclose(f);
+    ok = decode_png_file(path, px, h, w, want_c, header_only);
+  } else {
+    std::fclose(f);
+  }
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+// native size of an image file; 0 ok, -1 unreadable/unsupported
+int dl4j_image_probe(const char* path, long* h, long* w) {
+  std::vector<uint8_t> px;
+  long hh = 0, ww = 0;
+  if (!decode_any(path, px, hh, ww, 3, /*header_only=*/true)) return -1;
+  *h = hh;
+  *w = ww;
+  return 0;
+}
+
+// decode + bilinear-resize one image file into out [H, W, C] uint8
+// (C=3 RGB or C=1 grayscale; JPEG and PNG by magic bytes); 0 ok, -1 fail
+int dl4j_image_decode(const char* path, uint8_t* out, long H, long W,
+                      long C) {
+  if ((C != 1 && C != 3) || H <= 0 || W <= 0) return -1;
+  std::vector<uint8_t> px;
+  long h = 0, w = 0;
+  if (!decode_any(path, px, h, w, C, /*header_only=*/false)) return -1;
+  resize_bilinear_u8(px.data(), h, w, C, out, H, W);
+  return 0;
+}
+
+// decode '\n'-separated image files in parallel (order-preserving) into the
+// uint8 staging file [n, H, W, C] the image pipeline mmap-reads.
+// Returns 0 on success, k>0 = number of files that failed to decode
+// (staging file NOT written), -1 on argument/IO errors.
+int dl4j_image_stage(const char* paths, long n, const char* out_path,
+                     long H, long W, long C, int n_threads) {
+  if (!paths || n <= 0 || (C != 1 && C != 3)) return -1;
+  std::vector<std::string> files;
+  {
+    const char* s = paths;
+    while (*s) {
+      const char* e = std::strchr(s, '\n');
+      if (!e) {
+        files.emplace_back(s);
+        break;
+      }
+      files.emplace_back(s, e - s);
+      s = e + 1;
+    }
+  }
+  if (static_cast<long>(files.size()) != n) return -1;
+  // stream per-image pwrite at disjoint offsets — O(threads * image)
+  // memory, not O(dataset): ImageNet-scale staging must not buffer
+  // n*H*W*C bytes in RAM
+  const size_t img_bytes = static_cast<size_t>(H) * W * C;
+  int fd = ::open(out_path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  if (::ftruncate(fd, static_cast<off_t>(img_bytes * n)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  std::atomic<long> next{0}, failures{0};
+  std::atomic<bool> io_error{false};
+  auto work = [&]() {
+    std::vector<uint8_t> tile(img_bytes);
+    for (;;) {
+      long i = next.fetch_add(1);
+      if (i >= n) return;
+      if (dl4j_image_decode(files[i].c_str(), tile.data(), H, W, C) != 0) {
+        failures.fetch_add(1);
+        continue;
+      }
+      ssize_t w = ::pwrite(fd, tile.data(), img_bytes,
+                           static_cast<off_t>(img_bytes) * i);
+      if (w != static_cast<ssize_t>(img_bytes)) io_error.store(true);
+    }
+  };
+  int nt = n_threads > 0 ? n_threads : 4;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+  ::close(fd);
+  if (io_error.load()) return -1;
+  return static_cast<int>(failures.load());
+}
+
+}  // extern "C"
+
+#endif  // DL4J_WITH_CODECS

@@ -192,16 +192,22 @@ class TestPallasLRN:
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    rtol=2e-4, atol=2e-6)
 
-    def test_registry_selection(self, rng):
+    def test_registry_selection(self, rng, monkeypatch):
+        """r3: LRN is DEMOTED off-by-default (measured 0.98-1.01x vs XLA at
+        the AlexNet shape — parity, not a win). FORCE_PALLAS still selects
+        it when the structural requirements hold."""
         import jax.numpy as jnp
 
+        from deeplearning4j_tpu.common.env import env
         from deeplearning4j_tpu.ops.registry import get_op
 
         big = jnp.zeros((4, 32, 32, 64), jnp.float32)   # 4096 pixels
         small = jnp.zeros((1, 4, 4, 8), jnp.float32)
         op = get_op("lrn")
-        assert op.select(big).platform == "pallas"
-        assert op.select(small).platform != "pallas"
+        assert op.select(big).platform == "xla"          # demoted by default
+        monkeypatch.setattr(env, "force_pallas", True)
+        assert op.select(big).platform == "pallas"       # force overrides
+        assert op.select(small).platform != "pallas"     # structural holds
 
     def test_even_depth_matches_xla(self, rng):
         import jax.numpy as jnp
