@@ -778,8 +778,10 @@ def main():
         except Exception:
             pass
     if time.perf_counter() < deadline - 30:
-        try:    # the input path next to the model rate (host-side)
-            pipe = bench_pipeline(batch=batch, n=1024, epochs=2)
+        try:    # the input path next to the model rate (host-side);
+                # n must cover >= 1 batch or the rate reads as a bogus 0
+            pipe = bench_pipeline(batch=batch, n=max(1024, 4 * batch),
+                                  epochs=2)
             result["input_pipeline"] = {
                 "samples_per_sec": pipe["samples_per_sec"]["median"],
                 "native": pipe["native"],

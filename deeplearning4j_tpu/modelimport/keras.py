@@ -202,8 +202,12 @@ class KerasModelImport:
 
     @staticmethod
     def import_model(h5_path: str):
+        import zipfile
+
         import h5py
 
+        if zipfile.is_zipfile(h5_path):        # Keras 3 ".keras" archive
+            return KerasModelImport._import_keras_zip(h5_path)
         with h5py.File(h5_path, "r") as f:
             raw = f.attrs["model_config"]
             cfg = json.loads(raw if isinstance(raw, str) else raw.decode())
@@ -215,6 +219,88 @@ class KerasModelImport:
                 model = KerasModelImport._build(cfg)
                 KerasModelImport._load_weights(model, f, cfg)
         return model
+
+    # ------------------------------------------------- Keras 3 ".keras" zip
+    @staticmethod
+    def _import_keras_zip(path: str):
+        """Keras 3 archive: config.json (+ metadata.json) and
+        model.weights.h5 with weights under layers/<name>/vars/<i>.
+
+        Sequential and linear Functional configs route through the shared
+        _build (its layer mappers are format-agnostic; the v3 dtype-policy
+        dicts and batch_shape are already tolerated). Branched Functional
+        .keras configs use the v3 keras_history format for inbound_nodes —
+        unsupported here; export legacy whole-model h5 for those."""
+        import tempfile
+        import zipfile
+
+        import h5py
+
+        with zipfile.ZipFile(path) as z:
+            cfg = json.loads(z.read("config.json"))
+            if cfg["class_name"] in ("Functional", "Model") and \
+                    KerasModelImport._keras3_nonlinear(cfg):
+                raise NotImplementedError(
+                    "branched Functional .keras import is not supported "
+                    "yet — save the model as legacy whole-model h5 "
+                    "(model.save('m.h5')) instead")
+            model = KerasModelImport._build(cfg)
+            with tempfile.NamedTemporaryFile(suffix=".h5") as tmp:
+                tmp.write(z.read("model.weights.h5"))
+                tmp.flush()
+                with h5py.File(tmp.name, "r") as f:
+                    KerasModelImport._load_weights(
+                        model, f, cfg,
+                        reader=KerasModelImport._v3_layer_arrays)
+        return model
+
+    @staticmethod
+    def _keras3_nonlinear(cfg: dict) -> bool:
+        """Branch/merge detection for v3 configs (inbound_nodes carry
+        keras_history refs inside arg trees instead of nested lists)."""
+        def parents(lc):
+            out = []
+
+            def walk(obj):
+                if isinstance(obj, dict):
+                    if obj.get("class_name") == "__keras_tensor__":
+                        out.append(obj["config"]["keras_history"][0])
+                        return
+                    for v in obj.values():
+                        walk(v)
+                elif isinstance(obj, (list, tuple)):
+                    for v in obj:
+                        walk(v)
+
+            walk(lc.get("inbound_nodes") or [])
+            return out
+
+        consumed: dict = {}
+        for lc in cfg["config"]["layers"]:
+            ps = parents(lc)
+            if len(set(ps)) > 1:
+                return True
+            for p in ps:
+                consumed[p] = consumed.get(p, 0) + 1
+        return any(c > 1 for c in consumed.values())
+
+    @staticmethod
+    def _v3_layer_arrays(f, name):
+        """One layer's weight arrays from a v3 weights h5 (vars/<i> in
+        build order — same order as the legacy weight_names lists)."""
+        g = f.get(f"layers/{name}")
+        if g is None:
+            hits: list = []
+            f.visit(lambda p: hits.append(p)
+                    if p.split("/")[-1] == name else None)
+            for h in hits:
+                if "vars" in f[h]:
+                    g = f[h]
+                    break
+        if g is None or "vars" not in g:
+            return []
+        vg = g["vars"]
+        return [np.asarray(vg[str(i)]) for i in range(len(vg))]
 
     @staticmethod
     def _is_nonlinear(cfg: dict) -> bool:
@@ -360,9 +446,10 @@ class KerasModelImport:
 
     # -------------------------------------------------------------- weights
     @staticmethod
-    def _load_weights(model: MultiLayerNetwork, f, cfg: dict):
+    def _load_weights(model: MultiLayerNetwork, f, cfg: dict, reader=None):
+        reader = reader or read_h5_layer_arrays
         for li, (layer, kname) in enumerate(zip(model.layers, model._keras_names)):
-            ws = read_h5_layer_arrays(f, kname)
+            ws = reader(f, kname)
             if not ws:
                 continue
             KerasModelImport._copy_layer_weights(

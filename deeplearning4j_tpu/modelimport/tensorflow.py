@@ -306,6 +306,18 @@ def _identity(node, xs):
     return xs[0] if xs else None
 
 
+@tf_op("ReadVariableOp")
+def _read_variable(node, xs):
+    # the resource input already carries the checkpoint value (seeded by
+    # import_saved_model), so a read is an identity
+    return xs[0]
+
+
+@tf_op("VarIsInitializedOp")
+def _var_is_initialized(node, xs):
+    return np.asarray(True)
+
+
 @tf_op("Reshape")
 def _reshape(node, xs):
     shape = [int(d) for d in np.asarray(xs[1]).ravel()]
@@ -947,6 +959,11 @@ class TFImportedGraph:
         self.functions = functions or {}
         self.constants: Dict[str, np.ndarray] = {}
         self.placeholders: List[str] = []
+        # SavedModel support: checkpoint-restored values keyed by the
+        # VarHandleOp/VariableV2 node name (seeded into acts like
+        # constants), and the chosen SignatureDef {inputs, outputs}
+        self.variables: Dict[str, np.ndarray] = {}
+        self.signature: Optional[Dict[str, Dict[str, str]]] = None
         for n in nodes:
             if n.op == "Const":
                 self.constants[n.name] = n.attr("value").tensor
@@ -1001,6 +1018,13 @@ class TFImportedGraph:
                 continue
             if node.op in ("Placeholder", "Arg", "_Arg"):
                 continue  # fed externally
+            if node.op in ("VarHandleOp", "VariableV2", "Variable"):
+                if name not in acts:
+                    raise NotImplementedError(
+                        f"variable node '{name}' has no checkpoint value — "
+                        "was this graph imported without its SavedModel "
+                        "variables bundle (or with TF2 object-graph keys)?")
+                continue  # value seeded from the variables bundle
             if node.op in ("_Retval", "NoOp"):
                 if node.op == "_Retval" and node.inputs:
                     acts[name] = self._resolve(acts, node.inputs[0], op_of)
@@ -1096,9 +1120,29 @@ class TFImportedGraph:
             # bounds) stay concrete — jnp.asarray here would return a tracer
             # under jit on current JAX, breaking int(np.asarray(...)) reads
             acts[name] = const
+        for name, val in self.variables.items():
+            acts[name] = val
         for name, val in feeds.items():
             acts[name] = jnp.asarray(val)
         return self._execute(acts, outputs)
+
+    def run_signature(self, feeds: Dict[str, np.ndarray],
+                      signature_outputs: Optional[List[str]] = None):
+        """Execute via SignatureDef names (SavedModel serving contract):
+        ``feeds`` keyed by signature INPUT names; returns a dict keyed by
+        signature OUTPUT names."""
+        if not self.signature:
+            raise ValueError("graph has no SignatureDef (not a SavedModel?)")
+        tensor = lambda ref: ref.split(":")[0]
+        node_feeds = {tensor(self.signature["inputs"][k]): v
+                      for k, v in feeds.items()}
+        keys = signature_outputs or sorted(self.signature["outputs"])
+        vals = self.output(node_feeds,
+                           [tensor(self.signature["outputs"][k])
+                            for k in keys])
+        if len(keys) == 1:
+            vals = [vals]
+        return dict(zip(keys, vals))
 
     def as_function(self, outputs: Optional[List[str]] = None) -> Callable:
         """Jittable closure over the constants: fn(**feeds) -> outputs."""
@@ -1122,14 +1166,17 @@ class TFImportedGraph:
         """
         import jax.numpy as jnp
 
+        pool = dict(self.constants)
+        pool.update(self.variables)       # SavedModel weights fine-tune too
         names = trainable if trainable is not None else [
-            k for k, v in self.constants.items()
+            k for k, v in pool.items()
             if np.issubdtype(np.asarray(v).dtype, np.floating)
             and np.ndim(v) >= 1]
-        params = {k: jnp.asarray(self.constants[k]) for k in names}
+        params = {k: jnp.asarray(pool[k]) for k in names}
 
         def fn(params, feeds):
             acts: Dict[str, object] = dict(self.constants)
+            acts.update(self.variables)
             acts.update(params)
             for name, val in feeds.items():
                 acts[name] = jnp.asarray(val)
@@ -1247,6 +1294,46 @@ class TFImportedGraph:
         return sd
 
 
+def _parse_signatures(meta_graph: Dict[int, list]) -> Dict[str, dict]:
+    """MetaGraphDef.signature_def (field 5): map<string, SignatureDef>;
+    SignatureDef: inputs(1)/outputs(2) are map<string, TensorInfo>,
+    TensorInfo.name(1) is the "node:out" ref."""
+    sigs: Dict[str, dict] = {}
+    for ent in meta_graph.get(5, []):
+        e = parse_message(ent)
+        sd = parse_message(e[2][0])
+
+        def tensors(field):
+            out = {}
+            for m in sd.get(field, []):
+                me = parse_message(m)
+                ti = parse_message(me[2][0])
+                if 1 in ti:
+                    out[me[1][0].decode()] = ti[1][0].decode()
+            return out
+
+        sigs[e[1][0].decode()] = {"inputs": tensors(1),
+                                  "outputs": tensors(2)}
+    return sigs
+
+
+def _prune_to(nodes: List[NodeDef], roots: List[str]) -> List[NodeDef]:
+    """Subgraph reachable from ``roots`` (drops the saver/initializer
+    machinery a SavedModel graph carries alongside inference), preserving
+    the original (topological) order."""
+    by_name = {n.name: n for n in nodes}
+    keep = set()
+    stack = [r.split(":")[0].lstrip("^") for r in roots]
+    while stack:
+        name = stack.pop()
+        if name in keep or name not in by_name:
+            continue
+        keep.add(name)
+        stack.extend(i.split(":")[0].lstrip("^")
+                     for i in by_name[name].inputs)
+    return [n for n in nodes if n.name in keep]
+
+
 class TFGraphMapper:
     """importGraph entry point (TFGraphMapper.importGraph analog)."""
 
@@ -1259,3 +1346,63 @@ class TFGraphMapper:
                 buf = f.read()
         nodes, functions = parse_graph(buf)
         return TFImportedGraph(nodes, functions)
+
+    @staticmethod
+    def import_saved_model(path, signature: str = "serving_default"
+                           ) -> TFImportedGraph:
+        """Import a SavedModel DIRECTORY (saved_model.pb + variables/).
+
+        saved_model.pb wraps MetaGraphDef(s) (field 2) -> GraphDef (field
+        2) + function library; weights come from the tensor-bundle
+        checkpoint under variables/ and are seeded onto the graph's
+        VarHandleOp/VariableV2 nodes by node name (with the shared_name
+        attr as fallback) — the TF1-convention SavedModels of the
+        reference's era. TF2 object-graph checkpoints (keys like
+        "variables/0/.ATTRIBUTES/...") raise with guidance to export a
+        frozen GraphDef instead. The graph is pruned to what the chosen
+        signature's outputs reach (the saver/init machinery is dropped)."""
+        from pathlib import Path as _Path
+
+        from deeplearning4j_tpu.modelimport.tf_bundle import read_variables
+
+        d = _Path(path)
+        sm = parse_message((d / "saved_model.pb").read_bytes())
+        if 2 not in sm:
+            raise ValueError(f"{path}: no MetaGraphDef in saved_model.pb")
+        mg = parse_message(sm[2][0])
+        nodes, functions = parse_graph(mg[2][0])
+        sigs = _parse_signatures(mg)
+        if sigs and signature not in sigs:
+            # never substitute silently: the graph is pruned to the chosen
+            # signature's outputs, so a wrong pick corrupts the import
+            raise KeyError(
+                f"SavedModel has no signature {signature!r}; available: "
+                f"{sorted(sigs)}")
+        sig = sigs.get(signature)
+        if sig and sig["outputs"]:
+            nodes = _prune_to(nodes, list(sig["outputs"].values()))
+        g = TFImportedGraph(nodes, functions)
+        g.signature = sig
+
+        index = d / "variables" / "variables.index"
+        ckpt = read_variables(d / "variables" / "variables") \
+            if index.exists() else {}
+        missing = []
+        for n in nodes:
+            if n.op not in ("VarHandleOp", "VariableV2", "Variable"):
+                continue
+            shared = n.attr("shared_name")
+            cands = [n.name] + ([shared.s] if shared and shared.s else [])
+            val = next((ckpt[c] for c in cands if c in ckpt), None)
+            if val is None:
+                missing.append(n.name)
+            else:
+                g.variables[n.name] = val
+        if missing:
+            tf2_style = any("/.ATTRIBUTES/" in k for k in ckpt)
+            hint = (" (TF2 object-graph checkpoint keys detected — export "
+                    "a frozen GraphDef or a TF1-convention SavedModel)"
+                    if tf2_style else "")
+            raise NotImplementedError(
+                f"no checkpoint value for variable nodes {missing}{hint}")
+        return g

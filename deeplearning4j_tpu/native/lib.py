@@ -11,17 +11,22 @@ from typing import Optional
 
 _ROOT = Path(__file__).resolve().parent.parent.parent
 _SRC = _ROOT / "native" / "dl4jtpu_native.cpp"
+# committed PORTABLE artifact: codec-free, no shared-library dependencies
+# beyond libc/libstdc++ — the fallback for toolchain-less hosts
 _SO = _ROOT / "native" / "build" / "libdl4jtpu.so"
+# locally-built variant (preferred): includes the JPEG/PNG decode front
+# when this host has the codec dev files; never committed
+_SO_LOCAL = _ROOT / "native" / "build" / "libdl4jtpu_local.so"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
-    _SO.parent.mkdir(parents=True, exist_ok=True)
+def _build(out: Path) -> bool:
+    out.parent.mkdir(parents=True, exist_ok=True)
     base = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-            "-shared", "-o", str(_SO), str(_SRC)]
+            "-shared", "-o", str(out), str(_SRC)]
     # preferred: with the native JPEG/PNG decode front; fall back to a
     # codec-less build on hosts without libjpeg/libpng dev files (the
     # Python layer then decodes via PIL)
@@ -159,48 +164,60 @@ def trim_compile_cache(cache_dir: Optional[str] = None,
 
 def load_native_lib() -> Optional[ctypes.CDLL]:
     """Build (if needed) and load the native library; None if unavailable.
-    One attempt per process — success and failure are both cached."""
+    One attempt per process — success and failure are both cached.
+
+    Load order: locally-built variant (rebuilt when the source is newer;
+    may carry codec dependencies this host satisfies by construction) ->
+    committed portable artifact (codec-free; loads anywhere a libc does).
+    A failed load of one candidate falls through to the next, so a
+    committed artifact with missing sonames can never disable the whole
+    native layer on a toolchain-less host."""
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        stale = (_SRC.exists()
-                 and (not _SO.exists()
-                      or _SO.stat().st_mtime < _SRC.stat().st_mtime))
-        if stale and not _build():
-            return None
-        if not _SO.exists():
-            return None
-        try:
-            _lib = _declare(ctypes.CDLL(str(_SO)))
-        except (OSError, AttributeError):
-            # AttributeError: stale .so missing newer symbols. Rebuild, then
-            # load under a unique path — dlopen caches by pathname, so
-            # reopening _SO would hand back the stale library.
-            _lib = None
-            if _build():
-                import shutil
-                import tempfile
+        if _SRC.exists():
+            stale_local = (not _SO_LOCAL.exists()
+                           or _SO_LOCAL.stat().st_mtime
+                           < _SRC.stat().st_mtime)
+            if stale_local:
+                _build(_SO_LOCAL)      # failure is fine: fall back below
+        for cand in (_SO_LOCAL, _SO):
+            if not cand.exists():
+                continue
+            try:
+                _lib = _declare(ctypes.CDLL(str(cand)))
+                return _lib
+            except (OSError, AttributeError):
+                # OSError: unsatisfied dependency on this host;
+                # AttributeError: stale binary missing newer symbols —
+                # dlopen caches by pathname, so retry under a unique path
+                # after a rebuild when that is possible
+                _lib = None
+                if cand == _SO_LOCAL and _build(_SO_LOCAL):
+                    import shutil
+                    import tempfile
 
-                alt = None
-                try:
-                    # same dir as _SO: /tmp may be mounted noexec
-                    with tempfile.NamedTemporaryFile(suffix=".so",
-                                                     dir=str(_SO.parent),
-                                                     delete=False) as f:
-                        alt = f.name
-                    shutil.copy2(_SO, alt)
-                    _lib = _declare(ctypes.CDLL(alt))
-                except (OSError, AttributeError):
-                    _lib = None
-                finally:
-                    # the dlopen mapping survives the unlink on Linux
-                    if alt is not None:
-                        try:
-                            os.unlink(alt)
-                        except OSError:
-                            pass
+                    alt = None
+                    try:
+                        # same dir: /tmp may be mounted noexec
+                        with tempfile.NamedTemporaryFile(
+                                suffix=".so", dir=str(cand.parent),
+                                delete=False) as f:
+                            alt = f.name
+                        shutil.copy2(cand, alt)
+                        _lib = _declare(ctypes.CDLL(alt))
+                        return _lib
+                    except (OSError, AttributeError):
+                        _lib = None
+                    finally:
+                        # the dlopen mapping survives the unlink on Linux
+                        if alt is not None:
+                            try:
+                                os.unlink(alt)
+                            except OSError:
+                                pass
         return _lib
 
 

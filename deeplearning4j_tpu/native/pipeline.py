@@ -386,7 +386,7 @@ def probe_image(path) -> Tuple[int, int]:
         if lib.dl4j_image_probe(str(path).encode(), ctypes.byref(h),
                                 ctypes.byref(w)) == 0:
             return int(h.value), int(w.value)
-        raise ValueError(f"cannot decode image: {path}")
+        # non-JPEG/PNG format: PIL fallback below
     from PIL import Image
 
     with Image.open(path) as im:
@@ -403,9 +403,11 @@ def decode_image_file(path, image_shape) -> np.ndarray:
         rc = lib.dl4j_image_decode(
             str(path).encode(),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), H, W, C)
-        if rc != 0:
-            raise ValueError(f"cannot decode image: {path}")
-        return out
+        if rc == 0:
+            return out
+        # the native front covers JPEG/PNG; other formats (bmp/webp/...)
+        # fall through to PIL so a codec build never supports FEWER
+        # formats than a codec-less one
     return _pil_decode(path, image_shape)
 
 
@@ -437,15 +439,14 @@ def stage_image_files(paths, labels, directory, image_shape,
     img_path = directory / "images.u8"
     label_path = directory / "labels.bin"
     lib = load_native_lib()
+    rc = -1
     if lib is not None and hasattr(lib, "dl4j_image_stage"):
         rc = lib.dl4j_image_stage("\n".join(paths).encode(), len(paths),
                                   str(img_path).encode(), H, W, C, n_threads)
-        if rc > 0:
-            raise ValueError(f"{rc} image file(s) failed to decode")
-        if rc != 0:
-            raise RuntimeError("native image staging failed")
-    else:
-        # stream one decoded image at a time — never the whole dataset
+    if rc != 0:
+        # no codec build, or files the native front can't decode
+        # (non-JPEG/PNG): stream one PIL-decoded image at a time — never
+        # the whole dataset
         with open(img_path, "wb") as f:
             for p in paths:
                 f.write(_pil_decode(p, image_shape).tobytes())

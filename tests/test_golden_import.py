@@ -236,3 +236,149 @@ class TestImportThenFineTune:
             params, loss = step(params)
             losses.append(float(loss))
         assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+class TestSavedModelImport:
+    """r3 (VERDICT #6): SavedModel DIRECTORY import — saved_model.pb
+    (MetaGraphDef -> GraphDef + signatures) plus the tensor-bundle
+    variables checkpoint, read by the dependency-free bundle reader.
+    Fixture: TF1-convention CNN exported with tf.compat.v1
+    simple_save (committed binary + golden outputs)."""
+
+    def test_cnn_parity_and_signature(self):
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("saved_model_cnn_golden.npz"))
+        imp = TFGraphMapper.import_saved_model(_fx("saved_model_cnn"))
+        assert imp.signature["inputs"] == {"input": "input:0"}
+        assert set(imp.variables) == {"conv/w", "conv/b",
+                                      "dense/w", "dense/b"}
+        out = imp.run_signature({"input": g["x"]})
+        np.testing.assert_allclose(np.asarray(out["output"]), g["y"],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bundle_reader_standalone(self):
+        from deeplearning4j_tpu.modelimport.tf_bundle import read_variables
+
+        vs = read_variables(
+            str(_fx("saved_model_cnn")) + "/variables/variables")
+        assert vs["conv/w"].shape == (3, 3, 3, 4)
+        assert vs["dense/b"].shape == (5,)
+        np.testing.assert_allclose(vs["dense/b"], np.full(5, 0.1, np.float32))
+
+    def test_fine_tune_surface(self):
+        """import-then-train: SavedModel weights become trainable params."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(_fx("saved_model_cnn_golden.npz"))
+        imp = TFGraphMapper.import_saved_model(_fx("saved_model_cnn"))
+        fn, params = imp.as_trainable(outputs=["output"])
+        assert set(params) == {"conv/w", "conv/b", "dense/w", "dense/b"}
+        x = jnp.asarray(g["x"])
+
+        def loss(p):
+            return (fn(p, {"input": x}) ** 2).sum()
+
+        grads = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(v)).all() and
+                   np.abs(np.asarray(v)).sum() > 0 for v in grads.values())
+
+    def test_live_tf_savedmodel_roundtrip(self, tmp_path):
+        """Regenerate a SavedModel with the INSTALLED TF and import it —
+        guards against silently-stale committed fixtures. Generation runs
+        in a SUBPROCESS: tf.compat.v1.disable_eager_execution() is
+        process-global and would poison later Keras-3 tests."""
+        import subprocess
+        import sys
+        import textwrap
+
+        pytest.importorskip("tensorflow")
+
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        d = str(tmp_path / "sm")
+        script = textwrap.dedent("""
+            import sys
+            import numpy as np
+            import tensorflow as tf
+            tf1 = tf.compat.v1
+            tf1.disable_eager_execution()
+            d = sys.argv[1]
+            gdef = tf1.Graph()
+            with gdef.as_default():
+                x = tf1.placeholder(tf.float32, [None, 6], name="input")
+                w = tf1.get_variable(
+                    "w", [6, 3],
+                    initializer=tf1.glorot_uniform_initializer(seed=3))
+                b = tf1.get_variable(
+                    "b", [3], initializer=tf1.constant_initializer(0.2))
+                out = tf.nn.tanh(tf.matmul(x, w) + b, name="output")
+            with tf1.Session(graph=gdef) as sess:
+                sess.run(tf1.global_variables_initializer())
+                tf1.saved_model.simple_save(sess, d, {"input": x},
+                                            {"output": out})
+                xin = np.random.default_rng(1).normal(size=(4, 6)).astype(
+                    np.float32)
+                want = sess.run(out, {x: xin})
+            np.savez(d + "_golden.npz", x=xin, y=want)
+        """)
+        res = subprocess.run([sys.executable, "-c", script, d],
+                             capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stderr[-2000:]
+        g = np.load(d + "_golden.npz")
+        imp = TFGraphMapper.import_saved_model(d)
+        got = np.asarray(imp.run_signature({"input": g["x"]})["output"])
+        np.testing.assert_allclose(got, g["y"], rtol=1e-5, atol=1e-6)
+
+
+class TestKeras3ZipImport:
+    """r3 (VERDICT #6): Keras 3 ".keras" archive import (config.json +
+    model.weights.h5 with layers/<name>/vars/<i>)."""
+
+    def test_cnn_parity(self):
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+        g = np.load(_fx("k3_golden.npz"))
+        m = KerasModelImport.import_model(_fx("model_k3.keras"))
+        out = np.asarray(m.output(g["x"]))
+        np.testing.assert_allclose(out, g["y"], rtol=1e-4, atol=1e-5)
+
+    def test_live_keras3_roundtrip(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from keras import layers
+
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+        keras.utils.set_random_seed(5)
+        m = keras.Sequential([
+            keras.Input((10,)),
+            layers.Dense(8, activation="relu"),
+            layers.BatchNormalization(),
+            layers.Dense(3, activation="softmax"),
+        ])
+        p = str(tmp_path / "m.keras")
+        m.save(p)
+        x = np.random.default_rng(2).normal(size=(4, 10)).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        ours = KerasModelImport.import_model(p)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_branched_functional_raises(self, tmp_path):
+        keras = pytest.importorskip("keras")
+        from keras import layers
+
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+        inp = keras.Input((6,))
+        a = layers.Dense(4)(inp)
+        b = layers.Dense(4)(inp)
+        out = layers.Add()([a, b])
+        m = keras.Model(inp, out)
+        p = str(tmp_path / "branch.keras")
+        m.save(p)
+        with pytest.raises(NotImplementedError, match="h5"):
+            KerasModelImport.import_model(p)
