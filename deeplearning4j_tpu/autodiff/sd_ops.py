@@ -961,6 +961,81 @@ def _b_ctc_loss(attrs):
 
 
 # --------------------------------------------------------------------------
+# quantization (libnd4j's fake_quant_with_min_max_* declarable family;
+# blocks importing quantization-aware-training graphs without them)
+# --------------------------------------------------------------------------
+
+def _fq_nudged(mn, mx, num_bits, narrow):
+    """TF-semantics nudged quantization range: [min, max] adjusted so an
+    exact integer zero-point exists (FakeQuantWithMinMaxVars kernel)."""
+    qmin = 1.0 if narrow else 0.0
+    qmax = float((1 << num_bits) - 1)
+    scale = (mx - mn) / (qmax - qmin)
+    zp_from_min = qmin - mn / scale
+    # TF kernels round half UP (floor(v + 0.5)), not jnp.round's
+    # half-to-even — midpoint inputs must land on the same level
+    nudged_zp = jnp.where(zp_from_min < qmin, qmin,
+                          jnp.where(zp_from_min > qmax, qmax,
+                                    jnp.floor(zp_from_min + 0.5)))
+    return (qmin - nudged_zp) * scale, (qmax - nudged_zp) * scale, scale
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fake_quant(x, mn, mx, num_bits=8, narrow_range=False):
+    """Quantize-dequantize x to num_bits levels over the nudged [mn, mx]
+    range. mn/mx: scalars (per-tensor) or [C] vectors broadcast over the
+    LAST axis (per-channel). Gradient is TF's straight-through estimator:
+    dx passes inside the nudged range and is 0 outside; d(mn)/d(mx) collect
+    the out-of-range cotangents."""
+    nmin, nmax, scale = _fq_nudged(mn, mx, num_bits, narrow_range)
+    clamped = jnp.clip(x, nmin, nmax)
+    return jnp.floor((clamped - nmin) / scale + 0.5) * scale + nmin
+
+
+def _fq_fwd(x, mn, mx, num_bits, narrow_range):
+    return fake_quant(x, mn, mx, num_bits, narrow_range), (x, mn, mx)
+
+
+def _fq_bwd(num_bits, narrow_range, res, g):
+    x, mn, mx = res
+    nmin, nmax, _ = _fq_nudged(mn, mx, num_bits, narrow_range)
+    below = x < nmin
+    above = x > nmax
+    dx = jnp.where(below | above, 0.0, g)
+    axes = (tuple(range(jnp.ndim(g))) if jnp.ndim(mn) == 0
+            else tuple(range(jnp.ndim(g) - 1)))
+    dmn = jnp.where(below, g, 0.0).sum(axes).reshape(jnp.shape(mn))
+    dmx = jnp.where(above, g, 0.0).sum(axes).reshape(jnp.shape(mx))
+    return dx, dmn, dmx
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+@register_sd_op("fake_quant_with_min_max_vars")
+def _b_fq_vars(attrs):
+    nb = int(attrs.get("num_bits", 8))
+    nr = bool(attrs.get("narrow_range", False))
+    return lambda x, mn, mx: fake_quant(x, mn, mx, nb, nr)
+
+
+# same impl, the per-channel contract is carried by mn/mx being [C]
+register_sd_op("fake_quant_with_min_max_vars_per_channel")(_b_fq_vars)
+
+
+@register_sd_op("fake_quant_with_min_max_args")
+def _b_fq_args(attrs):
+    nb = int(attrs.get("num_bits", 8))
+    nr = bool(attrs.get("narrow_range", False))
+    mn = jnp.float32(attrs.get("min", -6.0))
+    mx = jnp.float32(attrs.get("max", 6.0))
+    return lambda x: fake_quant(x, mn, mx, nb, nr)
+
+
+# --------------------------------------------------------------------------
 # namespaces: sd.math / sd.nn / sd.linalg / sd.random / sd.image / sd.loss /
 # sd.bitwise (SDMath/SDNN/... analog). Methods map 1:1 onto registry names;
 # tensor args are inputs, keyword args become serialized attrs.

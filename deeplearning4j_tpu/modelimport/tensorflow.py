@@ -306,6 +306,34 @@ def _identity(node, xs):
     return xs[0] if xs else None
 
 
+def _fq_attrs(node):
+    nb = node.attr("num_bits")
+    nr = node.attr("narrow_range")
+    return (int(nb.i) if nb and nb.i is not None else 8,
+            bool(nr.b) if nr and nr.b is not None else False)
+
+
+@tf_op("FakeQuantWithMinMaxArgs")
+def _tf_fake_quant_args(node, xs):
+    from deeplearning4j_tpu.autodiff.sd_ops import fake_quant
+
+    nb, nr = _fq_attrs(node)
+    mn = node.attr("min")
+    mx = node.attr("max")
+    return fake_quant(xs[0],
+                      jnp.float32(mn.f if mn and mn.f is not None else -6.0),
+                      jnp.float32(mx.f if mx and mx.f is not None else 6.0),
+                      nb, nr)
+
+
+@tf_op("FakeQuantWithMinMaxVars", "FakeQuantWithMinMaxVarsPerChannel")
+def _tf_fake_quant_vars(node, xs):
+    from deeplearning4j_tpu.autodiff.sd_ops import fake_quant
+
+    nb, nr = _fq_attrs(node)
+    return fake_quant(xs[0], jnp.asarray(xs[1]), jnp.asarray(xs[2]), nb, nr)
+
+
 @tf_op("ReadVariableOp")
 def _read_variable(node, xs):
     # the resource input already carries the checkpoint value (seeded by
@@ -1133,13 +1161,14 @@ class TFImportedGraph:
         signature OUTPUT names."""
         if not self.signature:
             raise ValueError("graph has no SignatureDef (not a SavedModel?)")
-        tensor = lambda ref: ref.split(":")[0]
-        node_feeds = {tensor(self.signature["inputs"][k]): v
+        # inputs: strip ':0' to the placeholder NODE name; outputs: keep the
+        # full 'name:N' ref — _resolve understands it, and stripping would
+        # silently return output 0 of a multi-output node
+        node_feeds = {self.signature["inputs"][k].split(":")[0]: v
                       for k, v in feeds.items()}
         keys = signature_outputs or sorted(self.signature["outputs"])
         vals = self.output(node_feeds,
-                           [tensor(self.signature["outputs"][k])
-                            for k in keys])
+                           [self.signature["outputs"][k] for k in keys])
         if len(keys) == 1:
             vals = [vals]
         return dict(zip(keys, vals))

@@ -19,7 +19,8 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.modelimport.tensorflow import parse_message
+from deeplearning4j_tpu.modelimport.tensorflow import (_read_varint,
+                                                       parse_message)
 
 _TABLE_MAGIC = 0xDB4775248B80FB57
 
@@ -31,16 +32,7 @@ _DTYPES = {
 }
 
 
-def _varint(buf: bytes, pos: int) -> Tuple[int, int]:
-    out = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        out |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return out, pos
-        shift += 7
+_varint = _read_varint
 
 
 def _block_handle(buf: bytes, pos: int) -> Tuple[int, int, int]:

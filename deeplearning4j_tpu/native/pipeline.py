@@ -444,12 +444,13 @@ def stage_image_files(paths, labels, directory, image_shape,
         rc = lib.dl4j_image_stage("\n".join(paths).encode(), len(paths),
                                   str(img_path).encode(), H, W, C, n_threads)
     if rc != 0:
-        # no codec build, or files the native front can't decode
-        # (non-JPEG/PNG): stream one PIL-decoded image at a time — never
-        # the whole dataset
+        # no codec build, or some files the native front can't decode
+        # (non-JPEG/PNG in the mix): stream per-file — decode_image_file
+        # still uses the native decoder for each JPEG/PNG and PIL only for
+        # the odd formats; one image in memory at a time
         with open(img_path, "wb") as f:
             for p in paths:
-                f.write(_pil_decode(p, image_shape).tobytes())
+                f.write(decode_image_file(p, image_shape).tobytes())
     labels.tofile(label_path)
     return str(img_path), str(label_path)
 
