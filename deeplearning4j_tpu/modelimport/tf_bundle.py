@@ -19,8 +19,8 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.modelimport.tensorflow import (_read_varint,
-                                                       parse_message)
+from deeplearning4j_tpu.modelimport.tensorflow import (
+    _read_varint as _varint, parse_message)
 
 _TABLE_MAGIC = 0xDB4775248B80FB57
 
@@ -30,9 +30,6 @@ _DTYPES = {
     6: np.int8, 9: np.int64, 10: np.bool_, 14: None,  # 14 = bfloat16
     17: np.uint16, 19: np.float16, 22: np.uint32, 23: np.uint64,
 }
-
-
-_varint = _read_varint
 
 
 def _block_handle(buf: bytes, pos: int) -> Tuple[int, int, int]:

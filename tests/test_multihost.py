@@ -98,3 +98,114 @@ def test_two_process_data_parallel(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert "RESULT" in out, out[-2000:]
+
+
+# r3 (VERDICT #5): the FRAMEWORK stack across the process boundary, not a
+# toy regression — (a) a ParallelWrapper/MultiLayerNetwork fit whose SPMD
+# train step all-reduces between the two processes, with a param-sync
+# assertion across workers; (b) the hierarchical EncodedGradientTrainer
+# with the "dcn" axis mapped ACROSS the process boundary (intra-process
+# "data" axis at full precision, threshold-encoded exchange between
+# processes — SharedTrainingMaster's actual job in the reference).
+
+_FRAMEWORK_WORKER = textwrap.dedent("""\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1]); port = sys.argv[2]
+from deeplearning4j_tpu.parallel import initialize_distributed
+info = initialize_distributed(coordinator_address=f"127.0.0.1:{port}",
+                              num_processes=2, process_id=pid)
+assert info["global_devices"] == 8, info
+import numpy as np, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# ---------------- phase A: ParallelWrapper / MLN fit over the global mesh
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import Sgd
+from deeplearning4j_tpu.parallel import DeviceMesh, ParallelWrapper
+
+conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(lr=0.1)).list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(8)).build())
+model = MultiLayerNetwork(conf).init()
+mesh = DeviceMesh(data=8)          # 2 processes x 4 devices, one data axis
+wrapper = ParallelWrapper(model, mesh, prefetch_buffer=0)
+rng = np.random.default_rng(0)     # same data in both processes
+X = rng.normal(size=(64, 8)).astype(np.float32)
+Y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+l0 = wrapper.fit_batch((X, Y))
+for _ in range(80):
+    l = wrapper.fit_batch((X, Y))  # float() inside = per-step lockstep
+pnorm = float(sum(np.abs(np.asarray(jax.device_get(x))).sum()
+                  for x in jax.tree_util.tree_leaves(model.params)))
+print(f"MLN pid={pid} l0={l0:.4f} l={l:.4f} pnorm={pnorm:.6f}", flush=True)
+assert l < l0 * 0.7, (l0, l)
+
+# ------- phase B: hierarchical encoded exchange ACROSS the process boundary
+from deeplearning4j_tpu.parallel import EncodedGradientTrainer
+from deeplearning4j_tpu.parallel.mesh import multi_slice_mesh
+
+ms = multi_slice_mesh(2)           # dcn=2 == the process boundary; data=4
+def loss_fn(p, x, y):
+    return ((x @ p["w"] - y) ** 2).mean()
+tr = EncodedGradientTrainer(loss_fn, Sgd(lr=0.3), ms, axis="dcn",
+                            ici_axis="data", threshold=5e-3,
+                            adaptive=False)
+carry = tr.init({"w": jnp.zeros((4, 1), jnp.float32)})
+true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+Xb = rng.normal(size=(64, 4)).astype(np.float32)
+Yb = Xb @ true_w
+sh = NamedSharding(ms, P(("dcn", "data")))
+xg = jax.device_put(Xb, sh)
+yg = jax.device_put(Yb, sh)
+losses = []
+for _ in range(400):
+    carry, loss = tr.fit_batch(carry, xg, yg)
+    losses.append(float(loss))     # host fetch = per-step lockstep
+w = np.asarray(jax.device_get(carry["params"]["w"]))
+err = float(np.abs(w - true_w).max())
+print(f"ENC pid={pid} err={err:.4f} l0={losses[0]:.4f} l={losses[-1]:.6f}",
+      flush=True)
+assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+assert err < 0.3, err
+print(f"DONE pid={pid}", flush=True)
+""")
+
+
+def test_two_process_framework_stack(tmp_path):
+    worker = tmp_path / "worker_fw.py"
+    worker.write_text(_FRAMEWORK_WORKER)
+    repo = str(Path(__file__).resolve().parent.parent)
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "PYTHONPATH": repo},
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    pnorms = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert "DONE" in out and "ENC" in out, out[-2000:]
+        for line in out.splitlines():
+            if line.startswith("MLN"):
+                pnorms.append(float(line.split("pnorm=")[1]))
+    # the SPMD fit must leave BOTH processes with identical parameters
+    assert len(pnorms) == 2 and abs(pnorms[0] - pnorms[1]) < 1e-4, pnorms
