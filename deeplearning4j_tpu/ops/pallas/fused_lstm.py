@@ -531,20 +531,63 @@ def _fused_bwd(forget_gate_bias, reverse, res, g):
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
+def _pad_to_lanes(H: int) -> int:
+    """Next lane multiple: the padded hidden size the kernel entry point
+    runs AND the size the selection predicates must evaluate (one shared
+    definition so predicate and kernel can never disagree)."""
+    return -(-H // 128) * 128
+
+
+def _pad_gates(a, H, Hp, axis):
+    """Zero-pad the per-gate H-blocks of a gate-major [..., G*H] axis to
+    [..., G*Hp] (G inferred), keeping IFOG block order."""
+    G = a.shape[axis] // H
+    shape = list(a.shape)
+    shape[axis:axis + 1] = [G, H]
+    widths = [(0, 0)] * len(shape)
+    widths[axis + 1] = (0, Hp - H)
+    out = jnp.pad(a.reshape(shape), widths)
+    shape2 = list(a.shape)
+    shape2[axis] = G * Hp
+    return out.reshape(shape2)
+
+
 def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
                      forget_gate_bias=0.0, reverse=False):
-    """Drop-in accelerated impl of the "lstm_layer" op (same signature)."""
-    return _fused(x, h0, c0, W, R, b, peephole, float(forget_gate_bias),
-                  bool(reverse))
+    """Drop-in accelerated impl of the "lstm_layer" op (same signature).
+
+    Unaligned hidden sizes (H % 128 != 0 — e.g. the reference's stock
+    200-unit GravesLSTM configs, which cuDNN accelerates too) are
+    zero-PADDED to the next lane multiple: padded gate columns see zero
+    pre-activations, so z = tanh(0) = 0 keeps c = h = 0 in every padded
+    lane through the whole recurrence (forget-gate bias and peepholes
+    included: they multiply a zero c), and the backward's padded gate
+    gradients vanish the same way — slicing after the kernel is exact,
+    not approximate. The pad/slice is differentiable, so the
+    custom_vjp'd core needs no changes."""
+    H = R.shape[0]
+    Hp = _pad_to_lanes(H)
+    if Hp == H:
+        return _fused(x, h0, c0, W, R, b, peephole, float(forget_gate_bias),
+                      bool(reverse))
+    padh = lambda a: jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, Hp - H)])
+    Wp = _pad_gates(W, H, Hp, 1)
+    Rp = _pad_gates(jnp.pad(R, [(0, Hp - H), (0, 0)]), H, Hp, 1)
+    bp = _pad_gates(b, H, Hp, 0)
+    pp = None if peephole is None else _pad_gates(peephole, H, Hp, 0)
+    out, (hT, cT) = _fused(x, padh(h0), padh(c0), Wp, Rp, bp, pp,
+                           float(forget_gate_bias), bool(reverse))
+    return out[..., :H], (hT[..., :H], cT[..., :H])
 
 
 def _lstm_requires(x, h0, c0, W, R, b, *, peephole=None, **kw):
     # structural: a VMEM-feasible tile must exist (incl. reserve outputs),
     # sized with the SAME panel dtype _fused_recurrence will actually use
-    # (f32 in interpret mode, bf16 on TPU)
-    H = R.shape[0]
+    # (f32 in interpret mode, bf16 on TPU) and the PADDED hidden size the
+    # kernel will actually run
+    Hp = _pad_to_lanes(R.shape[0])
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
-    return lstm_tile(x.shape[0], H, rdtype_bytes=rb,
+    return lstm_tile(x.shape[0], Hp, rdtype_bytes=rb,
                      save_residuals=True) is not None
 
 
@@ -556,11 +599,11 @@ def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
     panels re-stream from HBM every timestep and the scan lowering wins
     (0.6-0.9x measured at B=256, H=512/1024) — those shapes stay on XLA,
     numbers in BASELINE.md."""
-    H = R.shape[0]
+    Hp = _pad_to_lanes(R.shape[0])         # unaligned H runs zero-padded
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
-    return (H % 128 == 0 and x.shape[0] % 8 == 0
-            and lstm_tile(x.shape[0], H, rdtype_bytes=rb,
-                          save_residuals=True) == H)
+    return (x.shape[0] % 8 == 0
+            and lstm_tile(x.shape[0], Hp, rdtype_bytes=rb,
+                          save_residuals=True) == Hp)
 
 
 register_impl("lstm_layer", platform="pallas", predicate=_lstm_applicable,

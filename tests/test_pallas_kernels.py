@@ -111,10 +111,15 @@ class TestFusedLSTM:
         # peephole (GravesLSTM) is fused in-kernel too (r2)
         assert op.select(x, h0, c0, W, R, b,
                          peephole=jnp.zeros(384)).platform == "pallas"
-        # unaligned hidden size -> xla
+        # unaligned hidden size: r3 runs it on the kernel via zero-padding
         R2 = jnp.zeros((100, 400))
         assert op.select(x, jnp.zeros((8, 100)), jnp.zeros((8, 100)),
-                         jnp.zeros((16, 400)), R2, jnp.zeros(400)).platform == "xla"
+                         jnp.zeros((16, 400)), R2,
+                         jnp.zeros(400)).platform == "pallas"
+        # unaligned BATCH (sublane) -> xla
+        x7 = jnp.zeros((7, 4, 16))
+        assert op.select(x7, jnp.zeros((7, 128)), jnp.zeros((7, 128)),
+                         W, R, b).platform == "xla"
 
 
 class TestFusedLSTMTiled:
@@ -480,3 +485,50 @@ class TestFusedLSTMBackwardKernel:
             peephole=cast(p))[0].astype(jnp.float32).sum())(cast(W))
         assert g.dtype == jnp.bfloat16
         assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+class TestFusedLSTMUnalignedHidden:
+    """r3: unaligned hidden sizes (the reference's stock 200-unit configs)
+    run on the kernel via exact zero-padding — padded lanes carry c = h = 0
+    through the whole recurrence, so outputs and ALL gradients match the
+    scan bit-for-math."""
+
+    @pytest.mark.parametrize("H", [200, 100])
+    @pytest.mark.parametrize("peephole", [False, True])
+    def test_forward_and_grads_match_scan(self, rng, H, peephole):
+        B, T, F = 8, 6, 10
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * 0.1)
+        c0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * 0.1)
+        W = jnp.asarray(rng.normal(size=(F, 4 * H)).astype(np.float32) * 0.1)
+        R = jnp.asarray(rng.normal(size=(H, 4 * H)).astype(np.float32) * 0.1)
+        b = jnp.asarray(rng.normal(size=(4 * H,)).astype(np.float32) * 0.1)
+        p = (jnp.asarray(rng.normal(size=(3 * H,)).astype(np.float32) * 0.1)
+             if peephole else None)
+        of, (hf, cf) = fused_lstm_layer(x, h0, c0, W, R, b, peephole=p,
+                                        forget_gate_bias=1.0)
+        orr, (hr, cr) = lstm_layer(x, h0, c0, W, R, b, peephole=p,
+                                   forget_gate_bias=1.0)
+        np.testing.assert_allclose(np.asarray(of), np.asarray(orr),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(cf), np.asarray(cr),
+                                   rtol=2e-4, atol=2e-5)
+        args = (x, h0, c0, W, R, b)
+        gk = jax.grad(lambda *a: fused_lstm_layer(
+            *a, peephole=p, forget_gate_bias=1.0)[0].sum(),
+            argnums=tuple(range(6)))(*args)
+        gs = jax.grad(lambda *a: lstm_layer(
+            *a, peephole=p, forget_gate_bias=1.0)[0].sum(),
+            argnums=tuple(range(6)))(*args)
+        for name, a, b_ in zip(("x", "h0", "c0", "W", "R", "b"), gk, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"d{name} H={H}")
+
+    def test_registry_selects_kernel_for_unaligned_h(self):
+        op = get_op("lstm_layer")
+        x = jnp.zeros((8, 4, 16))
+        h0 = c0 = jnp.zeros((8, 200))
+        assert op.select(x, h0, c0, jnp.zeros((16, 800)),
+                         jnp.zeros((200, 800)),
+                         jnp.zeros((800,))).platform == "pallas"
