@@ -136,7 +136,15 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
 class AttrValue:
     def __init__(self, buf: bytes):
         f = parse_message(buf)
-        self.s = f[2][0].decode() if 2 in f else None
+        # `s` attrs are usually ASCII (padding/data_format/shared_name) but
+        # TF2 graphs also stash serialized protos in string attrs — keep
+        # those as raw bytes (no consumer compares them against str)
+        self.s = None
+        if 2 in f:
+            try:
+                self.s = f[2][0].decode()
+            except UnicodeDecodeError:
+                self.s = f[2][0]
         self.i = _zigzag_ok_int64(f[3][0]) if 3 in f else None
         self.f = struct.unpack("<f", f[4][0])[0] if 4 in f else None
         self.b = bool(f[5][0]) if 5 in f else None
@@ -1346,6 +1354,43 @@ def _parse_signatures(meta_graph: Dict[int, list]) -> Dict[str, dict]:
     return sigs
 
 
+def _tf2_variable_keys(meta_graph: Dict[int, list],
+                       object_graph_raw: Optional[bytes]) -> Dict[str, str]:
+    """{SavedVariable.name: checkpoint_key} for TF2 SavedModels.
+
+    The SavedObjectGraph (MetaGraphDef.object_graph_def, field 7) and the
+    checkpoint's _CHECKPOINTABLE_OBJECT_GRAPH (a TrackableObjectGraph proto
+    stored as a DT_STRING tensor) index their nodes IDENTICALLY: node i
+    holding SavedVariable(name=6) corresponds to TrackableObject i whose
+    attributes (field 2) carry {name(1)="VARIABLE_VALUE",
+    checkpoint_key(3)}."""
+    if 7 not in meta_graph or not object_graph_raw:
+        return {}
+    from deeplearning4j_tpu.modelimport.tf_bundle import \
+        string_tensor_elements
+
+    try:
+        proto = string_tensor_elements(object_graph_raw, 1)[0]
+        track_nodes = parse_message(proto).get(1, [])
+        saved_nodes = parse_message(meta_graph[7][0]).get(1, [])
+        out: Dict[str, str] = {}
+        for i, so_buf in enumerate(saved_nodes):
+            so = parse_message(so_buf)
+            if 7 not in so or i >= len(track_nodes):   # not a variable
+                continue
+            name_f = parse_message(so[7][0]).get(6)
+            if not name_f:
+                continue
+            name = name_f[0].decode()
+            for attr in parse_message(track_nodes[i]).get(2, []):
+                a = parse_message(attr)
+                if a.get(1, [b""])[0] == b"VARIABLE_VALUE" and 3 in a:
+                    out.setdefault(name, a[3][0].decode())
+        return out
+    except Exception:
+        return {}        # malformed object graph: fall back to name match
+
+
 def _prune_to(nodes: List[NodeDef], roots: List[str]) -> List[NodeDef]:
     """Subgraph reachable from ``roots`` (drops the saver/initializer
     machinery a SavedModel graph carries alongside inference), preserving
@@ -1384,11 +1429,13 @@ class TFGraphMapper:
         saved_model.pb wraps MetaGraphDef(s) (field 2) -> GraphDef (field
         2) + function library; weights come from the tensor-bundle
         checkpoint under variables/ and are seeded onto the graph's
-        VarHandleOp/VariableV2 nodes by node name (with the shared_name
-        attr as fallback) — the TF1-convention SavedModels of the
-        reference's era. TF2 object-graph checkpoints (keys like
-        "variables/0/.ATTRIBUTES/...") raise with guidance to export a
-        frozen GraphDef instead. The graph is pruned to what the chosen
+        VarHandleOp/VariableV2 nodes. TF1-convention checkpoints resolve
+        by node name (shared_name attr as fallback); TF2 object-graph
+        checkpoints (keys like "_layers/1/_kernel/.ATTRIBUTES/...") are
+        resolved through the SavedObjectGraph + the checkpoint's
+        _CHECKPOINTABLE_OBJECT_GRAPH proto (SavedVariable names ->
+        checkpoint keys), so modern tf.saved_model.save(keras_model)
+        exports import directly. The graph is pruned to what the chosen
         signature's outputs reach (the saver/init machinery is dropped)."""
         from pathlib import Path as _Path
 
@@ -1414,24 +1461,31 @@ class TFGraphMapper:
         g.signature = sig
 
         index = d / "variables" / "variables.index"
-        ckpt = read_variables(d / "variables" / "variables") \
-            if index.exists() else {}
+        raw_entries: Dict[str, bytes] = {}
+        ckpt = read_variables(d / "variables" / "variables",
+                              raw=raw_entries) if index.exists() else {}
+        # TF2 exports key the checkpoint by OBJECT-GRAPH paths
+        # ("_layers/1/_kernel/.ATTRIBUTES/VARIABLE_VALUE"); the
+        # SavedObjectGraph (MetaGraphDef field 7) + the checkpoint's
+        # _CHECKPOINTABLE_OBJECT_GRAPH proto map SavedVariable names (which
+        # match VarHandleOp shared_names) onto those keys
+        name_to_key = _tf2_variable_keys(
+            mg, raw_entries.get("_CHECKPOINTABLE_OBJECT_GRAPH"))
         missing = []
         for n in nodes:
             if n.op not in ("VarHandleOp", "VariableV2", "Variable"):
                 continue
             shared = n.attr("shared_name")
             cands = [n.name] + ([shared.s] if shared and shared.s else [])
+            cands += [name_to_key[c] for c in list(cands)
+                      if c in name_to_key]
             val = next((ckpt[c] for c in cands if c in ckpt), None)
             if val is None:
                 missing.append(n.name)
             else:
                 g.variables[n.name] = val
         if missing:
-            tf2_style = any("/.ATTRIBUTES/" in k for k in ckpt)
-            hint = (" (TF2 object-graph checkpoint keys detected — export "
-                    "a frozen GraphDef or a TF1-convention SavedModel)"
-                    if tf2_style else "")
             raise NotImplementedError(
-                f"no checkpoint value for variable nodes {missing}{hint}")
+                f"no checkpoint value for variable nodes {missing} "
+                f"(checkpoint has {sorted(ckpt)[:8]}...)")
         return g

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import struct
 from pathlib import Path
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -87,9 +87,13 @@ def _parse_shape(buf: bytes) -> list:
     return dims
 
 
-def read_variables(prefix) -> Dict[str, np.ndarray]:
+def read_variables(prefix, raw: Optional[Dict[str, bytes]] = None
+                   ) -> Dict[str, np.ndarray]:
     """{tensor_name: ndarray} from a bundle checkpoint ``prefix`` (e.g.
-    <saved_model_dir>/variables/variables)."""
+    <saved_model_dir>/variables/variables). Entries with non-numeric
+    dtypes (e.g. the DT_STRING _CHECKPOINTABLE_OBJECT_GRAPH proto of TF2
+    checkpoints) are skipped — their raw bytes are collected into ``raw``
+    when a dict is passed."""
     prefix = str(prefix)
     entries = read_index(prefix + ".index")
     header = parse_message(entries.pop(b"", b""))
@@ -113,15 +117,35 @@ def read_variables(prefix) -> Dict[str, np.ndarray]:
         shard_id = entry.get(3, [0])[0]
         offset = entry.get(4, [0])[0]
         size = entry.get(5, [0])[0]
-        raw = shard(shard_id)[offset:offset + size]
+        data = shard(shard_id)[offset:offset + size]
         if dt == 14:        # bfloat16: u16 -> f32 via bit shift
-            u16 = np.frombuffer(raw, np.uint16)
+            u16 = np.frombuffer(data, np.uint16)
             arr = (u16.astype(np.uint32) << 16).view(np.float32)
         else:
             np_dt = _DTYPES.get(dt)
             if np_dt is None:
-                raise NotImplementedError(
-                    f"checkpoint tensor {key!r} has unsupported dtype {dt}")
-            arr = np.frombuffer(raw, np_dt)
+                if raw is None:   # caller gets no diagnostic channel: raise
+                    raise NotImplementedError(
+                        f"checkpoint tensor {key!r} has unsupported "
+                        f"dtype {dt}")
+                raw[key.decode()] = data
+                continue
+            arr = np.frombuffer(data, np_dt)
         out[key.decode()] = arr.reshape(shape).copy()
+    return out
+
+
+def string_tensor_elements(data: bytes, n: int = 1) -> list:
+    """Decode a bundle DT_STRING tensor payload: n varint64 lengths, a
+    4-byte crc32c of those lengths, then the concatenated bytes."""
+    lens = []
+    pos = 0
+    for _ in range(n):
+        v, pos = _varint(data, pos)
+        lens.append(v)
+    pos += 4                       # crc32c(lengths)
+    out = []
+    for ln in lens:
+        out.append(data[pos:pos + ln])
+        pos += ln
     return out
