@@ -1,20 +1,25 @@
 """ImageNet-style input pipeline feeding ResNet-50 (small-scale demo).
 
-The decode->augment->device-prefetch path (VERDICT r1 missing #5): raw
-uint8 images on disk, C++ worker threads doing random-crop + flip +
-normalize into float32 NHWC batches, async device staging overlapping the
-train step. At ImageNet scale the same iterator takes n=1.28M, 224x224
-crops from 256x256 stored images, and feeds the zoo ResNet50 entrypoint.
+The full input path: image FILES (JPEG here) -> native libjpeg decode +
+bilinear resize into the uint8 staging format (once) -> C++ worker
+threads doing random-crop + flip per epoch -> uint8 batches to the
+device, where the `(x/255 - mean)/std` normalization runs as one fused
+affine (`output="u8"`: 4x less host traffic and host->device transfer
+than float batches — this is the mode that sustains 1.5x the ResNet-50
+model rate on a single host core, see BASELINE.md). At ImageNet scale
+the same path takes n=1.28M files, 224x224 crops from 256x256 staged
+images, and feeds the zoo ResNet50 entrypoint.
 
-Run: python examples/imagenet_pipeline.py  (synthesizes a tiny dataset)
+Run: python examples/imagenet_pipeline.py  (synthesizes tiny JPEGs)
 """
 
 import tempfile
+from pathlib import Path
 
 import numpy as np
 
-from deeplearning4j_tpu.native.pipeline import (NativeImageDataSetIterator,
-                                                write_image_dataset)
+from deeplearning4j_tpu.native.pipeline import (image_files_iterator,
+                                                stage_image_files)
 from deeplearning4j_tpu.zoo import ResNet50
 
 # imagenet normalization constants
@@ -24,21 +29,32 @@ STD = [0.229, 0.224, 0.225]
 
 def main(n: int = 64, stored: int = 40, crop: int = 32, classes: int = 10,
          batch: int = 16, epochs: int = 2):
-    rng = np.random.default_rng(0)
-    imgs = rng.integers(0, 256, size=(n, stored, stored, 3)).astype(np.uint8)
-    labels = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
-    img_path, label_path = write_image_dataset(tempfile.mkdtemp(), imgs, labels)
+    from PIL import Image
 
-    train = NativeImageDataSetIterator(
-        img_path, label_path, n, (stored, stored, 3), classes,
-        batch_size=batch, crop=(crop, crop), augment=True, shuffle=True,
-        mean=MEAN, std=STD, device_prefetch=True)
+    rng = np.random.default_rng(0)
+    d = Path(tempfile.mkdtemp())
+    paths = []
+    labels = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    for i in range(n):      # a tiny synthetic "dataset directory" of JPEGs
+        arr = rng.integers(0, 256, size=(stored, stored, 3)).astype(np.uint8)
+        p = d / f"img_{i:04d}.jpg"
+        Image.fromarray(arr).save(p, quality=92)
+        paths.append(p)
+
+    train = image_files_iterator(
+        paths, labels, (stored, stored, 3), classes, batch_size=batch,
+        crop=(crop, crop), augment=True, shuffle=True,
+        mean=MEAN, std=STD, output="u8")
     print(f"pipeline: native={train.native}, "
           f"{train.batches_per_epoch()} batches/epoch")
 
     model = ResNet50(height=crop, width=crop, num_classes=classes,
                      dtype="bf16").init()
-    model.fit(train, epochs=epochs)
+    for _ in range(epochs):
+        for ds in train:
+            # device-side normalize fuses into the first conv
+            model.fit_batch((train.normalize(ds.features), ds.labels))
+        train.reset()
     print("final loss:", model.score_value)
     return model.score_value
 
