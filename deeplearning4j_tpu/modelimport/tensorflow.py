@@ -1485,7 +1485,12 @@ class TFGraphMapper:
             else:
                 g.variables[n.name] = val
         if missing:
+            og_hint = ""
+            if any("/.ATTRIBUTES/" in k for k in ckpt) and not name_to_key:
+                og_hint = (" — the checkpoint uses TF2 object-graph keys "
+                           "but the SavedObjectGraph could not be resolved "
+                           "(unrecognized proto layout?)")
             raise NotImplementedError(
                 f"no checkpoint value for variable nodes {missing} "
-                f"(checkpoint has {sorted(ckpt)[:8]}...)")
+                f"(checkpoint has {sorted(ckpt)[:8]}...){og_hint}")
         return g

@@ -18,8 +18,7 @@ from pathlib import Path
 
 import numpy as np
 
-from deeplearning4j_tpu.native.pipeline import (image_files_iterator,
-                                                stage_image_files)
+from deeplearning4j_tpu.native.pipeline import image_files_iterator
 from deeplearning4j_tpu.zoo import ResNet50
 
 # imagenet normalization constants
