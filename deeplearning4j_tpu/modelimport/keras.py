@@ -69,6 +69,24 @@ def _pad(cfg):
     return "same" if cfg.get("padding", "valid") == "same" else "valid"
 
 
+def _keras_histories(obj, out=None):
+    """Collect keras_history refs ([layer, node_idx, tensor_idx]) from a
+    v3 inbound_nodes arg tree, in traversal order — the ONE walker shared
+    by branch detection and config normalization."""
+    if out is None:
+        out = []
+    if isinstance(obj, dict):
+        if obj.get("class_name") == "__keras_tensor__":
+            out.append(obj["config"]["keras_history"])
+            return out
+        for v in obj.values():
+            _keras_histories(v, out)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _keras_histories(v, out)
+    return out
+
+
 class KerasLayerMapper:
     """Maps one Keras layer config dict -> (native layer or None, is_input)."""
 
@@ -238,42 +256,73 @@ class KerasModelImport:
 
         with zipfile.ZipFile(path) as z:
             cfg = json.loads(z.read("config.json"))
-            if cfg["class_name"] in ("Functional", "Model") and \
-                    KerasModelImport._keras3_nonlinear(cfg):
-                raise NotImplementedError(
-                    "branched Functional .keras import is not supported "
-                    "yet — save the model as legacy whole-model h5 "
-                    "(model.save('m.h5')) instead")
-            model = KerasModelImport._build(cfg)
+            branched = (cfg["class_name"] in ("Functional", "Model")
+                        and KerasModelImport._keras3_nonlinear(cfg))
+            if branched:
+                model = KerasModelImport._build_graph(
+                    KerasModelImport._normalize_keras3_functional(cfg))
+            else:
+                model = KerasModelImport._build(cfg)
+            auto = KerasModelImport._v3_auto_names(cfg)
+            reader = lambda f, name: KerasModelImport._v3_layer_arrays(
+                f, name, auto)
             with tempfile.NamedTemporaryFile(suffix=".h5") as tmp:
                 tmp.write(z.read("model.weights.h5"))
                 tmp.flush()
                 with h5py.File(tmp.name, "r") as f:
-                    KerasModelImport._load_weights(
-                        model, f, cfg,
-                        reader=KerasModelImport._v3_layer_arrays)
+                    if branched:
+                        KerasModelImport._load_weights_graph(model, f,
+                                                             reader=reader)
+                    else:
+                        KerasModelImport._load_weights(model, f, cfg,
+                                                       reader=reader)
         return model
+
+    @staticmethod
+    def _normalize_keras3_functional(cfg: dict) -> dict:
+        """Rewrite a v3 Functional config into the keras2 shape
+        _build_graph consumes: inbound_nodes become
+        [[[parent, node_idx, tensor_idx, {}], ...]] (keras_history refs
+        pulled from the arg trees, in order) and input/output_layers
+        become nested [[name, 0, 0], ...] lists."""
+        import copy
+
+        cfg = copy.deepcopy(cfg)
+
+        for lc in cfg["config"]["layers"]:
+            nodes = lc.get("inbound_nodes") or []
+            if len(nodes) > 1:
+                # a layer CALLED more than once (shared weights at several
+                # graph positions) — collapsing its call nodes would build
+                # a wrong topology
+                raise NotImplementedError(
+                    f"layer {lc['config'].get('name')!r} is called "
+                    "multiple times (shared layer); save as legacy h5 "
+                    "(model.save('m.h5')) for this topology")
+            hs = _keras_histories(nodes)
+            lc["inbound_nodes"] = (
+                [[[h[0], h[1], h[2], {}] for h in hs]] if hs else [])
+
+        def norm_io(v):
+            if not v:
+                return []
+            if isinstance(v[0], str):          # single flat [name, n, t]
+                return [v]
+            return v
+
+        cfg["config"]["input_layers"] = norm_io(
+            cfg["config"].get("input_layers"))
+        cfg["config"]["output_layers"] = norm_io(
+            cfg["config"].get("output_layers"))
+        return cfg
 
     @staticmethod
     def _keras3_nonlinear(cfg: dict) -> bool:
         """Branch/merge detection for v3 configs (inbound_nodes carry
         keras_history refs inside arg trees instead of nested lists)."""
         def parents(lc):
-            out = []
-
-            def walk(obj):
-                if isinstance(obj, dict):
-                    if obj.get("class_name") == "__keras_tensor__":
-                        out.append(obj["config"]["keras_history"][0])
-                        return
-                    for v in obj.values():
-                        walk(v)
-                elif isinstance(obj, (list, tuple)):
-                    for v in obj:
-                        walk(v)
-
-            walk(lc.get("inbound_nodes") or [])
-            return out
+            return [h[0]
+                    for h in _keras_histories(lc.get("inbound_nodes") or [])]
 
         consumed: dict = {}
         for lc in cfg["config"]["layers"]:
@@ -285,10 +334,44 @@ class KerasModelImport:
         return any(c > 1 for c in consumed.values())
 
     @staticmethod
-    def _v3_layer_arrays(f, name):
+    def _v3_auto_names(cfg: dict) -> dict:
+        """{config layer name: save-time h5 group name}. Keras 3's h5
+        store keys layers by AUTO-GENERATED snake_case(class) + per-base
+        counter assigned in config order at save time — NOT by the user's
+        layer names (a model with Dense layers named 'da'/'db' stores them
+        under 'dense'/'dense_1')."""
+        import re
+
+        def snake(cls):
+            t = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", cls)
+            t = re.sub(r"([a-z])([A-Z])", r"\1_\2", t)
+            return t.lower()
+
+        counters: dict = {}
+        out: dict = {}
+        for lc in cfg["config"]["layers"]:
+            if lc["class_name"] == "InputLayer":
+                continue
+            base = snake(lc["class_name"])
+            k = counters.get(base, 0)
+            counters[base] = k + 1
+            out[lc["config"]["name"]] = base if k == 0 else f"{base}_{k}"
+        return out
+
+    @staticmethod
+    def _v3_layer_arrays(f, name, auto_names=None):
         """One layer's weight arrays from a v3 weights h5 (vars/<i> in
-        build order — same order as the legacy weight_names lists)."""
-        g = f.get(f"layers/{name}")
+        build order — same order as the legacy weight_names lists). Tries
+        the config name first (sequential saves where names coincide with
+        the auto names), then the save-time auto name."""
+        # AUTO name first: Keras 3 always stores under snake_case(class)
+        # + counter, so a user name colliding with ANOTHER layer's auto
+        # name (e.g. first Dense named "dense_1") must not win
+        g = None
+        if auto_names and name in auto_names:
+            g = f.get(f"layers/{auto_names[name]}")
+        if g is None:
+            g = f.get(f"layers/{name}")
         if g is None:
             hits: list = []
             f.visit(lambda p: hits.append(p)
@@ -431,13 +514,14 @@ class KerasModelImport:
         return model
 
     @staticmethod
-    def _load_weights_graph(model, f):
+    def _load_weights_graph(model, f, reader=None):
         from deeplearning4j_tpu.nn.conf.graph import LayerVertex
 
+        reader = reader or read_h5_layer_arrays
         for name, vertex in model.conf.vertices.items():
             if not isinstance(vertex, LayerVertex):
                 continue
-            ws = read_h5_layer_arrays(f, name)
+            ws = reader(f, name)
             if not ws:
                 continue
             KerasModelImport._copy_layer_weights(

@@ -367,21 +367,31 @@ class TestKeras3ZipImport:
         got = np.asarray(ours.output(x))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
-    def test_branched_functional_raises(self, tmp_path):
+    def test_branched_functional(self, tmp_path):
+        """r3: branched Functional .keras — v3 keras_history inbound_nodes
+        normalized to vertex edges, weights resolved through the save-time
+        AUTO names (user-named Dense layers store under dense/dense_1/...),
+        residual add + concat merge topology."""
         keras = pytest.importorskip("keras")
         from keras import layers
 
         from deeplearning4j_tpu.modelimport.keras import KerasModelImport
 
-        inp = keras.Input((6,))
-        a = layers.Dense(4)(inp)
-        b = layers.Dense(4)(inp)
-        out = layers.Add()([a, b])
+        keras.utils.set_random_seed(6)
+        inp = keras.Input((6,), name="inp")
+        a = layers.Dense(4, activation="relu", name="branch_a")(inp)
+        b = layers.Dense(4, activation="tanh", name="branch_b")(inp)
+        add = layers.Add(name="residual")([a, b])
+        cat = layers.Concatenate(name="merge")([add, a])
+        out = layers.Dense(3, activation="softmax", name="head")(cat)
         m = keras.Model(inp, out)
         p = str(tmp_path / "branch.keras")
         m.save(p)
-        with pytest.raises(NotImplementedError, match="h5"):
-            KerasModelImport.import_model(p)
+        x = np.random.default_rng(7).normal(size=(4, 6)).astype(np.float32)
+        want = m.predict(x, verbose=0)
+        ours = KerasModelImport.import_model(p)
+        got = np.asarray(ours.output(x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
 class TestQuantGraphImport:
