@@ -15,10 +15,13 @@ from deeplearning4j_tpu.datasets.normalizers import (
 )
 from deeplearning4j_tpu.datasets.mnist import EmnistDataSetIterator, MnistDataSetIterator
 from deeplearning4j_tpu.datasets.cifar import Cifar10DataSetIterator, SvhnDataSetIterator
+from deeplearning4j_tpu.datasets.real import (DigitsDataSetIterator,
+                                              TabularDataSetIterator)
 
 __all__ = [
     "DataSet", "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
     "AsyncPrefetchIterator", "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler", "MnistDataSetIterator",
     "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator",
+    "DigitsDataSetIterator", "TabularDataSetIterator",
 ]
