@@ -1279,6 +1279,24 @@ class TFImportedGraph:
                 handles[name] = sd.tanh(x(0), name=name)
             elif node.op == "Softmax":
                 handles[name] = sd.softmax(x(0), name=name)
+            elif node.op == "FakeQuantWithMinMaxArgs":
+                nb, nr = _fq_attrs(node)
+                mn = node.attr("min")
+                mx = node.attr("max")
+                handles[name] = sd._op(
+                    "fake_quant_with_min_max_args", x(0),
+                    attrs={"min": mn.f if mn and mn.f is not None else -6.0,
+                           "max": mx.f if mx and mx.f is not None else 6.0,
+                           "num_bits": nb, "narrow_range": nr}, name=name)
+            elif node.op in ("FakeQuantWithMinMaxVars",
+                             "FakeQuantWithMinMaxVarsPerChannel"):
+                nb, nr = _fq_attrs(node)
+                opname = ("fake_quant_with_min_max_vars_per_channel"
+                          if node.op.endswith("PerChannel")
+                          else "fake_quant_with_min_max_vars")
+                handles[name] = sd._op(
+                    opname, x(0), x(1), x(2),
+                    attrs={"num_bits": nb, "narrow_range": nr}, name=name)
             elif node.op in ("Identity", "StopGradient", "PreventGradient"):
                 handles[name] = sd.identity(x(0), name=name)
             elif node.op == "Reshape":

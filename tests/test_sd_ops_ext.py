@@ -494,3 +494,20 @@ class TestFakeQuant:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6,
                                        err_msg=f"d{name}")
+
+
+class TestFakeQuantToSameDiff:
+    def test_quant_graph_to_samediff_parity(self):
+        """The QAT fixture imports through to_samediff too (the importer's
+        graph-object path), replaying the committed goldens."""
+        import os
+
+        fx = os.path.join(os.path.dirname(__file__), "fixtures")
+        from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+        g = np.load(os.path.join(fx, "quant_golden.npz"))
+        imp = TFGraphMapper.import_graph(os.path.join(fx, "quant_graph.pb"))
+        sd = imp.to_samediff()
+        out = sd.output("output", input=g["x"])
+        np.testing.assert_allclose(np.asarray(out), g["out"],
+                                   rtol=1e-5, atol=1e-6)
