@@ -476,6 +476,28 @@ class MultiLayerNetwork:
         self.params[layer_index] = lparams
         return float(loss)
 
+    def as_loss_fn(self, train: bool = False):
+        """(loss_fn(params, x, y) -> scalar, initial params) — the
+        functional surface the parallel trainers consume
+        (ParameterAveragingTrainer / EncodedGradientTrainer take a loss
+        over a params TREE, not a model object).
+
+        Network state (BN running stats, RNN carries) is FROZEN at export
+        time: the functional trainers carry parameters only, exactly like
+        the reference's parameter server exchanged `params()` and not
+        updater-internal state. train=True runs train-mode forward (batch
+        statistics in BN) without a dropout key; leave False for nets with
+        dropout."""
+        state = self.state
+        layers = self.layers
+
+        def loss_fn(params, x, y):
+            preout, _, out_mask, _ = self._forward(params, state, x, train,
+                                                   None, None)
+            return layers[-1].score_from_preout(y, preout, out_mask).mean()
+
+        return loss_fn, self.params
+
     # ----------------------------------------------------------------- score
     def score(self, ds=None) -> float:
         """Loss on a dataset without updating (MultiLayerNetwork.score(DataSet))."""

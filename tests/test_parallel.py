@@ -864,3 +864,82 @@ class TestZigzagAtScale:
         with pytest.raises(ValueError, match="CAUSAL"):
             sequence_parallel_encoder({}, jnp.zeros((1, 128, 128)), mesh.mesh,
                                       n_heads=1, causal=False, impl="zigzag")
+
+
+class TestSparkLocalSgdRouting:
+    """r3: the Spark facade HONORS averaging_frequency — K>1 routes fit()
+    to the real local-SGD ParameterAveragingTrainer over the model's
+    functional loss and writes averaged params back into the network."""
+
+    def _data(self, rng, n=256):
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+        from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+        return x, y, ArrayDataSetIterator(x, y, batch_size=64)
+
+    def test_k4_trains_and_syncs_back(self, rng):
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        x, y, it = self._data(rng)
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        net = _model(seed=11)
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8), net, tm)
+        l0 = net.score((x, y))
+        spark.fit(it, epochs=12)
+        l1 = net.score((x, y))
+        assert l1 < l0 * 0.8, (l0, l1)
+
+    def test_k1_unchanged_sync_path(self, rng):
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        x, y, it = self._data(rng)
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(1).build())
+        net = _model(seed=11)
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8), net, tm)
+        l0 = net.score((x, y))
+        spark.fit(it, epochs=3)
+        assert net.score((x, y)) < l0
+
+    def test_unsupported_configs_rejected_loudly(self, rng):
+        """Configs whose semantics the functional path would silently
+        change (dropout, l1/l2, clipping, frozen layers) are refused."""
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(lr=0.1))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        x, y, it = self._data(rng, n=256)
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8), conf, tm)
+        with pytest.raises(NotImplementedError, match="dropout"):
+            spark.fit(it, epochs=1)
+
+    def test_uneven_tail_dropped_with_warning(self, rng):
+        import warnings as _w
+
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        x = rng.normal(size=(200, 8)).astype(np.float32)   # 64,64,64 + 8 tail
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 200)]
+        from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+        it = ArrayDataSetIterator(x, y, batch_size=64)
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        net = _model(seed=11)
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8), net, tm)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            spark.fit(it, epochs=4)   # 12 full batches -> 3 rounds
+        assert any("dropped" in str(r.message) for r in rec)
