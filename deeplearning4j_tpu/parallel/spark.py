@@ -177,6 +177,12 @@ class SparkDl4jMultiLayer:
         averaging_frequency=1 (exact) or the standalone
         ParameterAveragingTrainer with a custom loss."""
         net = self.network
+        if not hasattr(net, "as_loss_fn"):
+            raise NotImplementedError(
+                "averaging_frequency>1 is implemented for "
+                "MultiLayerNetwork models; for ComputationGraph use "
+                "averaging_frequency=1 (exact sync averaging) or "
+                "parallel.ParameterAveragingTrainer with a custom loss")
         conf = net.conf
         problems = []
         if getattr(conf, "max_grad_norm", 0):

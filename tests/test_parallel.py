@@ -943,3 +943,24 @@ class TestSparkLocalSgdRouting:
             _w.simplefilter("always")
             spark.fit(it, epochs=4)   # 12 full batches -> 3 rounds
         assert any("dropped" in str(r.message) for r in rec)
+
+    def test_graph_models_k_gt_1_rejected(self, rng):
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkComputationGraph)
+
+        gb = (NeuralNetConfiguration.builder().updater(Sgd(lr=0.1))
+              .graph_builder().add_inputs("in")
+              .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+              .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                            loss="mcxent"), "d")
+              .set_input_types(**{"in": InputType.feed_forward(8)})
+              .set_outputs("out"))
+        conf = gb.build()
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(2).build())
+        x, y, it = self._data(rng, n=128)
+        spark = SparkComputationGraph(DeviceMesh(data=8), conf, tm)
+        with pytest.raises(NotImplementedError, match="ComputationGraph"):
+            spark.fit(it, epochs=1)
