@@ -224,6 +224,39 @@ class ComputationGraph:
         """ComputationGraph.rnnClearPreviousState analog."""
         self._rnn_carries = None
 
+    def as_loss_fn(self, train: bool = False):
+        """(loss_fn(params, x, y) -> scalar, initial params) — the
+        functional surface the parallel trainers consume (the
+        ComputationGraph counterpart of MultiLayerNetwork.as_loss_fn).
+
+        x: one array for single-input graphs or a {input_name: array}
+        dict; y likewise for the graph's outputs. Network state is FROZEN
+        at export time and regularization terms are NOT included — the
+        Spark facade rejects configs where that would change semantics."""
+        state = self.state
+        conf = self.conf
+
+        def loss_fn(params, x, y):
+            inputs = self._as_input_dict(x)
+            labels = y if isinstance(y, dict) else \
+                {conf.network_outputs[0]: y}
+            acts, _, preouts, _ = self._forward(params, state, inputs,
+                                                train, None,
+                                                want_preout=True)
+            loss = 0.0
+            for name in conf.network_outputs:
+                v = conf.vertices[name]
+                if name in preouts and hasattr(v.layer,
+                                               "score_from_preout"):
+                    loss = loss + v.layer.score_from_preout(
+                        labels[name], preouts[name], None).mean()
+                else:
+                    d = acts[name] - labels[name]
+                    loss = loss + (d * d).mean()
+            return loss
+
+        return loss_fn, self.params
+
     # ------------------------------------------------------------------- fit
     def _loss(self, params, state, inputs, labels: dict, rng, masks):
         acts, new_state, preouts, out_feats = self._forward(

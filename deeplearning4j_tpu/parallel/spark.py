@@ -164,9 +164,14 @@ class SparkDl4jMultiLayer:
         # moments restart fresh, so re-init the model's own opt state to
         # match the new params rather than leaving stale moments)
         self.network.params = trainer.params(carry)
-        self.network.opt_state = [
-            u.init_state(p) for u, p in zip(self.network._updaters,
-                                            self.network.params)]
+        ups = self.network._updaters
+        if isinstance(self.network.params, dict):   # ComputationGraph
+            self.network.opt_state = {
+                n: ups[n].init_state(p)
+                for n, p in self.network.params.items()}
+        else:                                        # MultiLayerNetwork
+            self.network.opt_state = [
+                u.init_state(p) for u, p in zip(ups, self.network.params)]
         return self.network
 
     def _check_local_sgd_supported(self, K):
@@ -177,17 +182,24 @@ class SparkDl4jMultiLayer:
         averaging_frequency=1 (exact) or the standalone
         ParameterAveragingTrainer with a custom loss."""
         net = self.network
-        if not hasattr(net, "as_loss_fn"):
-            raise NotImplementedError(
-                "averaging_frequency>1 is implemented for "
-                "MultiLayerNetwork models; for ComputationGraph use "
-                "averaging_frequency=1 (exact sync averaging) or "
-                "parallel.ParameterAveragingTrainer with a custom loss")
         conf = net.conf
         problems = []
         if getattr(conf, "max_grad_norm", 0):
             problems.append("gradient clipping (max_grad_norm)")
-        for i, l in enumerate(net.layers):
+        if hasattr(net, "layers"):           # MultiLayerNetwork
+            named = [(str(i), l) for i, l in enumerate(net.layers)]
+        else:                                # ComputationGraph
+            from deeplearning4j_tpu.nn.conf.graph import LayerVertex
+
+            # the round batch plumbing carries ONE features array and ONE
+            # labels array; multi-input/-output graphs need the dict-fed
+            # standalone trainer instead
+            if len(conf.network_inputs) != 1 or \
+                    len(conf.network_outputs) != 1:
+                problems.append("multiple graph inputs/outputs")
+            named = [(n, v.layer) for n, v in conf.vertices.items()
+                     if isinstance(v, LayerVertex)]
+        for i, l in named:
             if getattr(l, "dropout", 0.0):
                 problems.append(f"layer {i} dropout")
             if getattr(l, "l1", 0.0) or getattr(l, "l2", 0.0):
@@ -199,6 +211,9 @@ class SparkDl4jMultiLayer:
             if type(l).__name__.startswith("BatchNormalization"):
                 problems.append(f"layer {i} batch normalization "
                                 "(running stats frozen on this path)")
+            if type(l).__name__ == "CenterLossOutputLayer":
+                problems.append(f"layer {i} center loss (centers state "
+                                "and center term need the fit path)")
         if problems:
             raise NotImplementedError(
                 "averaging_frequency>1 routes through the functional "

@@ -944,23 +944,32 @@ class TestSparkLocalSgdRouting:
             spark.fit(it, epochs=4)   # 12 full batches -> 3 rounds
         assert any("dropped" in str(r.message) for r in rec)
 
-    def test_graph_models_k_gt_1_rejected(self, rng):
+    def test_graph_model_k_gt_1_trains(self, rng):
+        """ComputationGraph models route through CG.as_loss_fn on the
+        K>1 local-SGD path too."""
         from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
         from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
         from deeplearning4j_tpu.parallel.spark import (
             ParameterAveragingTrainingMaster, SparkComputationGraph)
 
-        gb = (NeuralNetConfiguration.builder().updater(Sgd(lr=0.1))
+        gb = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(lr=0.2))
               .graph_builder().add_inputs("in")
-              .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+              .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
               .add_layer("out", OutputLayer(n_out=4, activation="softmax",
                                             loss="mcxent"), "d")
               .set_input_types(**{"in": InputType.feed_forward(8)})
               .set_outputs("out"))
         conf = gb.build()
         tm = (ParameterAveragingTrainingMaster.Builder()
-              .batch_size_per_worker(8).averaging_frequency(2).build())
-        x, y, it = self._data(rng, n=128)
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        x = rng.normal(size=(256, 8)).astype(np.float32)
+        w = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[np.argmax(x @ w, 1)]
+        from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+        it = ArrayDataSetIterator(x, y, batch_size=64)
         spark = SparkComputationGraph(DeviceMesh(data=8), conf, tm)
-        with pytest.raises(NotImplementedError, match="ComputationGraph"):
-            spark.fit(it, epochs=1)
+        net = spark.fit(it, epochs=12)
+        out = np.asarray(net.output(x))
+        acc = (out.argmax(1) == y.argmax(1)).mean()
+        assert acc > 0.8, acc
