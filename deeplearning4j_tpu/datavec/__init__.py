@@ -2,10 +2,10 @@
 
 Reference analog: the `datavec/` module family (SURVEY.md §1 L3) —
 RecordReader implementations (org.datavec.api.records.reader.impl.*),
-Schema + TransformProcess (org.datavec.api.transform.**) and the
-local executor. TPU-first: ETL stays host-side numpy (the device only sees
-ready batches), composing with the async device-prefetch iterators in
-deeplearning4j_tpu.datasets.
+Schema + TransformProcess + conditions/reducers/joins/analysis
+(org.datavec.api.transform.**) and the local executor. TPU-first: ETL stays
+host-side numpy (the device only sees ready batches), composing with the
+async device-prefetch iterators in deeplearning4j_tpu.datasets.
 """
 
 from deeplearning4j_tpu.datavec.schema import ColumnType, Schema
@@ -13,11 +13,24 @@ from deeplearning4j_tpu.datavec.records import (
     CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
     ImageRecordReader, LineRecordReader, RecordReader,
 )
+from deeplearning4j_tpu.datavec.conditions import (
+    BooleanCondition, ColumnCondition, Condition, equal_to, greater_than,
+    in_set, is_invalid, less_than,
+)
 from deeplearning4j_tpu.datavec.transform import TransformProcess
-from deeplearning4j_tpu.datavec.iterators import RecordReaderDataSetIterator
+from deeplearning4j_tpu.datavec.reduce import Reducer
+from deeplearning4j_tpu.datavec.join import Join
+from deeplearning4j_tpu.datavec.analysis import DataAnalysis, analyze
+from deeplearning4j_tpu.datavec.iterators import (
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
+)
 
 __all__ = [
     "ColumnType", "Schema", "RecordReader", "CSVRecordReader",
     "CSVSequenceRecordReader", "LineRecordReader", "CollectionRecordReader",
     "ImageRecordReader", "TransformProcess", "RecordReaderDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
+    "Condition", "ColumnCondition", "BooleanCondition",
+    "less_than", "greater_than", "equal_to", "in_set", "is_invalid",
+    "Reducer", "Join", "DataAnalysis", "analyze",
 ]

@@ -52,6 +52,19 @@ class Schema:
         cols = ", ".join(f"{c.name}:{c.type.value}" for c in self.columns)
         return f"Schema({cols})"
 
+    # ------------------------------------------------------------------ json
+    def to_dict(self) -> dict:
+        return {"columns": [
+            {"name": c.name, "type": c.type.value,
+             **({"categories": c.categories} if c.categories else {})}
+            for c in self.columns]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Schema":
+        return Schema([ColumnMeta(c["name"], ColumnType(c["type"]),
+                                  c.get("categories"))
+                       for c in d["columns"]])
+
     # --------------------------------------------------------------- builder
     class Builder:
         def __init__(self):

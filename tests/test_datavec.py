@@ -227,3 +227,393 @@ class TestRealData:
                                                    train=False,
                                                    shuffle=False))
         assert ev.accuracy() > 0.90, ev.accuracy()
+
+
+class TestConditions:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_integer("id")
+                .add_column_double("value")
+                .add_column_categorical("state", "CA", "NY", "TX")
+                .build())
+
+    def test_column_condition_ops(self):
+        from deeplearning4j_tpu.datavec import (equal_to, greater_than, in_set,
+                                                is_invalid, less_than)
+        s = self._schema()
+        r = [3, 2.5, "NY"]
+        assert less_than("value", 3.0).check(s, r)
+        assert not greater_than("value", 3.0).check(s, r)
+        assert equal_to("state", "NY").check(s, r)
+        assert in_set("state", ["CA", "NY"]).check(s, r)
+        assert not is_invalid("value").check(s, r)
+        assert is_invalid("value").check(s, [3, float("nan"), "NY"])
+        assert is_invalid("value").check(s, [3, "", "NY"])
+
+    def test_boolean_combinators(self):
+        from deeplearning4j_tpu.datavec import equal_to, greater_than
+        s = self._schema()
+        cond = greater_than("value", 1.0) & equal_to("state", "CA")
+        assert cond.check(s, [1, 2.0, "CA"])
+        assert not cond.check(s, [1, 2.0, "NY"])
+        assert (~cond).check(s, [1, 2.0, "NY"])
+        either = equal_to("state", "CA") | equal_to("state", "TX")
+        assert either.check(s, [1, 0.0, "TX"])
+
+    def test_condition_filter_removes_matching(self):
+        # reference semantics: ConditionFilter REMOVES satisfying records
+        from deeplearning4j_tpu.datavec import less_than
+        tp = (TransformProcess.builder(self._schema())
+              .condition_filter(less_than("value", 1.0))
+              .build())
+        out = tp.execute([[1, 0.5, "CA"], [2, 2.5, "TX"]])
+        assert out == [[2, 2.5, "TX"]]
+
+    def test_conditional_replace_and_invalid(self):
+        from deeplearning4j_tpu.datavec import is_invalid, less_than
+        tp = (TransformProcess.builder(self._schema())
+              .replace_invalid_with("value", 0.0)
+              .conditional_replace_value("value", -1.0, less_than("value", 0.5))
+              .build())
+        out = tp.execute([[1, "", "CA"], [2, 3.0, "NY"]])
+        assert out == [[1, -1.0, "CA"], [2, 3.0, "NY"]]
+        assert not is_invalid("value").check(tp.final_schema(), out[0])
+
+
+class TestNewTransforms:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_string("name")
+                .add_column_double("a")
+                .add_column_double("b")
+                .add_column_integer("k")
+                .build())
+
+    def test_rename_duplicate_constant(self):
+        tp = (TransformProcess.builder(self._schema())
+              .rename_column("a", "alpha")
+              .duplicate_column("b", "b2")
+              .add_constant_column("one", "integer", 1)
+              .build())
+        assert tp.final_schema().names == ["name", "alpha", "b", "b2", "k",
+                                           "one"]
+        out = tp.execute([["x", 1.0, 2.0, 3]])
+        assert out == [["x", 1.0, 2.0, 2.0, 3, 1]]
+
+    def test_string_ops(self):
+        tp = (TransformProcess.builder(self._schema())
+              .change_case("name", "upper")
+              .append_string("name", "!")
+              .replace_string("name", "B", "Z")
+              .concat_columns("tag", "-", "name", "k")
+              .build())
+        out = tp.execute([["ab", 0.0, 0.0, 7]])
+        assert out == [["AZ!", 0.0, 0.0, 7, "AZ!-7"]]
+
+    def test_columns_math_and_integer_math(self):
+        tp = (TransformProcess.builder(self._schema())
+              .double_columns_math_op("sum_ab", "add", "a", "b")
+              .double_columns_math_op("ratio", "divide", "a", "b")
+              .integer_math_op("k", "multiply", 3)
+              .build())
+        out = tp.execute([["x", 6.0, 2.0, 5]])
+        assert out == [["x", 6.0, 2.0, 15, 8.0, 3.0]]
+        assert tp.final_schema().column("sum_ab").type == ColumnType.DOUBLE
+
+    def test_integer_to_categorical(self):
+        tp = (TransformProcess.builder(self._schema())
+              .integer_to_categorical("k", "zero", "one", "two")
+              .build())
+        out = tp.execute([["x", 0.0, 0.0, 1]])
+        assert out[0][3] == "one"
+        assert tp.final_schema().column("k").categories == ["zero", "one",
+                                                            "two"]
+
+    def test_time_transforms(self):
+        s = (Schema.builder().add_column_string("ts").build())
+        tp = (TransformProcess.builder(s)
+              .string_to_time("ts", "%Y-%m-%d %H:%M:%S")
+              .derive_column_from_time("ts", "hour", "hour_of_day")
+              .derive_column_from_time("ts", "year", "year")
+              .build())
+        out = tp.execute([["2019-06-01 13:30:00"]])
+        assert out[0][1] == 13 and out[0][2] == 2019
+        assert tp.final_schema().column("ts").type == ColumnType.TIME
+
+
+class TestReducer:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_string("key")
+                .add_column_double("x")
+                .add_column_integer("n")
+                .build())
+
+    def test_group_by_aggregations(self):
+        from deeplearning4j_tpu.datavec import Reducer
+        red = (Reducer.builder("key")
+               .sum_columns("x")
+               .count_columns("n")
+               .build())
+        tp = (TransformProcess.builder(self._schema()).reduce(red).build())
+        out = tp.execute([["a", 1.0, 10], ["b", 5.0, 20], ["a", 2.0, 30]])
+        assert out == [["a", 3.0, 2], ["b", 5.0, 1]]
+        assert tp.final_schema().names == ["key", "sum(x)", "count(n)"]
+
+    def test_stdev_and_unique(self):
+        from deeplearning4j_tpu.datavec import Reducer
+        red = (Reducer.builder("key")
+               .stdev_columns("x").count_unique_columns("n").build())
+        out = red.reduce(self._schema(),
+                         [["a", 1.0, 1], ["a", 3.0, 1], ["a", 5.0, 2]])
+        assert out[0][1] == pytest.approx(2.0)  # sample stdev of 1,3,5
+        assert out[0][2] == 2
+
+
+class TestJoin:
+    def test_inner_and_left_outer(self):
+        from deeplearning4j_tpu.datavec import Join
+        left = (Schema.builder().add_column_integer("id")
+                .add_column_string("name").build())
+        right = (Schema.builder().add_column_integer("id")
+                 .add_column_double("score").build())
+        lrec = [[1, "a"], [2, "b"], [3, "c"]]
+        rrec = [[1, 0.5], [3, 0.7], [4, 0.9]]
+        inner = (Join.builder("inner").set_schemas(left, right)
+                 .set_keys("id").build())
+        assert inner.execute(lrec, rrec) == [[1, "a", 0.5], [3, "c", 0.7]]
+        assert inner.output_schema().names == ["id", "name", "score"]
+        louter = (Join.builder("left_outer").set_schemas(left, right)
+                  .set_keys("id").build())
+        assert louter.execute(lrec, rrec) == [
+            [1, "a", 0.5], [2, "b", None], [3, "c", 0.7]]
+        fouter = (Join.builder("full_outer").set_schemas(left, right)
+                  .set_keys("id").build())
+        assert [4, None, 0.9] in fouter.execute(lrec, rrec)
+
+
+class TestAnalysis:
+    def test_analyze_columns(self):
+        from deeplearning4j_tpu.datavec import analyze
+        s = (Schema.builder()
+             .add_column_double("x")
+             .add_column_categorical("c", "A", "B")
+             .add_column_string("s")
+             .build())
+        recs = [[1.0, "A", "hi"], [3.0, "B", "worlds"], [5.0, "A", "hi"]]
+        da = analyze(s, recs)
+        xa = da.column_analysis("x")
+        assert xa.min == 1.0 and xa.max == 5.0
+        assert xa.mean == pytest.approx(3.0)
+        assert xa.stdev == pytest.approx(2.0)
+        ca = da.column_analysis("c")
+        assert ca.counts == {"A": 2, "B": 1}
+        sa = da.column_analysis("s")
+        assert sa.count_unique == 2
+        assert sa.min_length == 2 and sa.max_length == 6
+        assert "DataAnalysis" in repr(da)
+
+    def test_invalid_counting(self):
+        from deeplearning4j_tpu.datavec import analyze
+        s = Schema.builder().add_column_double("x").build()
+        da = analyze(s, [[1.0], [""], [float("nan")], [2.0]])
+        xa = da.column_analysis("x")
+        assert xa.count == 2 and xa.count_invalid == 2
+
+
+class TestSequenceTransforms:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_string("key")
+                .add_column_integer("t")
+                .add_column_double("v")
+                .build())
+
+    def test_convert_to_sequence_groups_and_sorts(self):
+        tp = (TransformProcess.builder(self._schema())
+              .convert_to_sequence("key", "t")
+              .build())
+        out = tp.execute([["a", 2, 1.0], ["b", 1, 9.0], ["a", 1, 0.5]])
+        assert out == [[["a", 1, 0.5], ["a", 2, 1.0]], [["b", 1, 9.0]]]
+
+    def test_record_transform_applies_inside_sequences(self):
+        tp = (TransformProcess.builder(self._schema())
+              .convert_to_sequence("key", "t")
+              .double_math_op("v", "multiply", 10.0)
+              .convert_from_sequence()
+              .build())
+        out = tp.execute([["a", 1, 0.5], ["a", 2, 1.0]])
+        assert out == [["a", 1, 5.0], ["a", 2, 10.0]]
+
+    def test_offset_sequence_next_step_target(self):
+        # label column shifted -1: row t carries v from t+1 (next-step target)
+        tp = (TransformProcess.builder(self._schema())
+              .duplicate_column("v", "target")
+              .convert_to_sequence("key", "t")
+              .offset_sequence(["target"], -1)
+              .build())
+        out = tp.execute([["a", 1, 1.0], ["a", 2, 2.0], ["a", 3, 3.0]])
+        assert out == [[["a", 1, 1.0, 2.0], ["a", 2, 2.0, 3.0]]]
+
+    def test_offset_positive_and_trim(self):
+        tp = (TransformProcess.builder(self._schema())
+              .convert_to_sequence("key", "t")
+              .offset_sequence(["v"], 1)
+              .build())
+        out = tp.execute([["a", 1, 1.0], ["a", 2, 2.0], ["a", 3, 3.0]])
+        # row t gets v from t-1; first row trimmed
+        assert out == [[["a", 2, 1.0], ["a", 3, 2.0]]]
+        tp2 = (TransformProcess.builder(self._schema())
+               .convert_to_sequence("key", "t")
+               .trim_sequence(1)
+               .build())
+        assert tp2.execute([["a", 1, 1.0], ["a", 2, 2.0]]) == [[["a", 2, 2.0]]]
+
+    def test_split_by_length(self):
+        tp = (TransformProcess.builder(self._schema())
+              .convert_to_sequence("key", "t")
+              .split_sequence_by_length(2)
+              .build())
+        out = tp.execute([["a", i, float(i)] for i in range(5)])
+        assert [len(s) for s in out] == [2, 2, 1]
+
+    def test_sequence_step_requires_sequence_mode(self):
+        b = TransformProcess.builder(self._schema()).offset_sequence(["v"], 1)
+        with pytest.raises(ValueError, match="sequence mode"):
+            b.build().execute([["a", 1, 1.0]])
+
+    def test_execute_sequences_input(self):
+        # sequences straight from CSVSequenceRecordReader-style input
+        tp = (TransformProcess.builder(self._schema())
+              .double_math_op("v", "add", 1.0)
+              .build())
+        out = tp.execute([[["a", 1, 1.0], ["a", 2, 2.0]]], sequences=True)
+        assert out == [[["a", 1, 2.0], ["a", 2, 3.0]]]
+
+
+class TestTransformJson:
+    def test_round_trip(self):
+        from deeplearning4j_tpu.datavec import Reducer, less_than
+        s = (Schema.builder()
+             .add_column_string("key")
+             .add_column_double("v")
+             .add_column_categorical("state", "CA", "NY")
+             .build())
+        tp = (TransformProcess.builder(s)
+              .condition_filter(less_than("v", 0.0))
+              .conditional_replace_value("v", 9.0, less_than("v", 1.0))
+              .categorical_to_integer("state")
+              .double_math_op("v", "multiply", 2.0)
+              .reduce(Reducer.builder("key").sum_columns("v")
+                      .take_first_columns("state").build())
+              .build())
+        js = tp.to_json()
+        tp2 = TransformProcess.from_json(js)
+        recs = [["a", 0.5, "CA"], ["a", 3.0, "NY"], ["b", -1.0, "CA"]]
+        assert tp2.execute(recs) == tp.execute(recs)
+        assert tp2.final_schema().names == tp.final_schema().names
+
+    def test_raw_callable_rejected(self):
+        s = Schema.builder().add_column_double("v").build()
+        tp = (TransformProcess.builder(s)
+              .filter(lambda sch, r: True).build())
+        with pytest.raises(ValueError, match="cannot be serialized"):
+            tp.to_json()
+
+
+class TestSequenceIterator:
+    def test_padded_batches_with_masks(self):
+        from deeplearning4j_tpu.datavec import (
+            CollectionRecordReader, SequenceRecordReaderDataSetIterator)
+
+        class SeqReader(CollectionRecordReader):
+            pass  # CollectionRecordReader already yields whatever items given
+
+        seqs = [
+            [[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 2]],
+            [[0.7, 0.8, 1]],
+        ]
+        it = SequenceRecordReaderDataSetIterator(
+            SeqReader(seqs), batch_size=2, label_index=-1, num_classes=3)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 2)
+        assert ds.labels.shape == (2, 3, 3)
+        assert ds.features_mask.tolist() == [[1, 1, 1], [1, 0, 0]]
+        # padded steps are zero
+        assert ds.features[1, 1:].sum() == 0
+        assert ds.labels[0, 2, 2] == 1.0
+
+    def test_align_end_left_pads(self):
+        from deeplearning4j_tpu.datavec import (
+            CollectionRecordReader, SequenceRecordReaderDataSetIterator)
+        seqs = [[[1.0, 0], [2.0, 1]], [[3.0, 1]]]
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionRecordReader(seqs), batch_size=2, num_classes=2,
+            align="end")
+        ds = next(iter(it))
+        assert ds.features_mask.tolist() == [[1, 1], [0, 1]]
+        assert ds.features[1, 1, 0] == 3.0
+
+    def test_regression_labels(self):
+        from deeplearning4j_tpu.datavec import (
+            CollectionRecordReader, SequenceRecordReaderDataSetIterator)
+        seqs = [[[1.0, 0.5], [2.0, 0.7]]]
+        it = SequenceRecordReaderDataSetIterator(
+            CollectionRecordReader(seqs), batch_size=1, regression=True)
+        ds = next(iter(it))
+        assert ds.labels.shape == (1, 2, 1)
+        assert ds.labels[0, 1, 0] == pytest.approx(0.7)
+
+
+class TestReviewFixes:
+    def test_is_invalid_type_aware(self):
+        # categorical/string columns must not treat valid values as invalid
+        from deeplearning4j_tpu.datavec import is_invalid
+        s = (Schema.builder().add_column_categorical("state", "CA", "NY")
+             .add_column_string("name").add_column_double("x").build())
+        assert not is_invalid("state").check(s, ["NY", "bob", 1.0])
+        assert is_invalid("state").check(s, ["??", "bob", 1.0])
+        assert not is_invalid("name").check(s, ["NY", "bob", 1.0])
+        assert is_invalid("name").check(s, ["NY", "", 1.0])
+        # replace_invalid_with leaves valid categoricals alone
+        tp = (TransformProcess.builder(s)
+              .replace_invalid_with("state", "CA").build())
+        assert tp.execute([["NY", "b", 1.0], ["??", "b", 1.0]]) == [
+            ["NY", "b", 1.0], ["CA", "b", 1.0]]
+
+    def test_global_steps_guard_mode(self):
+        s = (Schema.builder().add_column_string("k")
+             .add_column_integer("t").add_column_double("v").build())
+        # sequence-only global step on flat records: clear error, no
+        # silent per-column slicing
+        tp = (TransformProcess.builder(s)
+              .split_sequence_by_length(1).build())
+        with pytest.raises(ValueError, match="sequence mode"):
+            tp.execute([["a", 1, 1.0]])
+        # flat-record-only step in sequence mode: clear error too
+        from deeplearning4j_tpu.datavec import Reducer
+        tp2 = (TransformProcess.builder(s)
+               .convert_to_sequence("k", "t")
+               .reduce(Reducer.builder("k").sum_columns("v").build())
+               .build())
+        with pytest.raises(ValueError, match="flat-record mode"):
+            tp2.execute([["a", 1, 1.0]])
+
+    def test_integer_math_java_semantics(self):
+        s = Schema.builder().add_column_integer("n").build()
+        div = (TransformProcess.builder(s)
+               .integer_math_op("n", "divide", 2).build())
+        assert div.execute([[-7], [7]]) == [[-3], [3]]  # truncate toward zero
+        mod = (TransformProcess.builder(s)
+               .integer_math_op("n", "modulus", 2).build())
+        assert mod.execute([[-7], [7]]) == [[-1], [1]]  # sign of dividend
+
+    def test_day_of_week_joda_convention(self):
+        s = Schema.builder().add_column_string("ts").build()
+        tp = (TransformProcess.builder(s)
+              .string_to_time("ts", "%Y-%m-%d")
+              .derive_column_from_time("ts", "dow", "day_of_week")
+              .build())
+        # 2019-06-03 was a Monday -> 1 (Joda), not 0 (python weekday)
+        assert tp.execute([["2019-06-03"]])[0][1] == 1
+        assert tp.execute([["2019-06-09"]])[0][1] == 7  # Sunday
