@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Sequence
 
-from deeplearning4j_tpu.datavec.conditions import sample_stdev
+from deeplearning4j_tpu.datavec.conditions import sample_stdev, try_float
 from deeplearning4j_tpu.datavec.schema import ColumnMeta, ColumnType, Schema
 
 _NUMERIC_OPS = ("min", "max", "sum", "mean", "stdev")
@@ -26,7 +26,11 @@ def _apply(op: str, values: list):
         return values[0]
     if op == "take_last":
         return values[-1]
-    nums = [float(v) for v in values]
+    # invalid/empty values are skipped, matching analyze()'s counting
+    # (shared try_float semantics); all-invalid groups reduce to NaN
+    nums = [f for f in (try_float(v) for v in values) if f is not None]
+    if not nums:
+        return float("nan")
     if op == "min":
         return min(nums)
     if op == "max":

@@ -11,10 +11,16 @@ batched advantage actor-critic (the async machinery existed to keep Java
 threads busy, not for learning quality).
 """
 
-from deeplearning4j_tpu.rl.env import CartPole, MDP
-from deeplearning4j_tpu.rl.replay import ExpReplay
-from deeplearning4j_tpu.rl.dqn import QLearningDiscreteDense
+from deeplearning4j_tpu.rl.env import (CartPole, FrameSkipWrapper, MDP,
+                                       PixelGridWorld)
+from deeplearning4j_tpu.rl.replay import ExpReplay, NStepAccumulator
+from deeplearning4j_tpu.rl.history import (HistoryConfiguration,
+                                           HistoryProcessor)
+from deeplearning4j_tpu.rl.dqn import (QLearningDiscreteConv,
+                                       QLearningDiscreteDense)
 from deeplearning4j_tpu.rl.actor_critic import A2CDiscreteDense
 
-__all__ = ["MDP", "CartPole", "ExpReplay", "QLearningDiscreteDense",
-           "A2CDiscreteDense"]
+__all__ = ["MDP", "CartPole", "PixelGridWorld", "FrameSkipWrapper",
+           "ExpReplay", "NStepAccumulator", "HistoryProcessor",
+           "HistoryConfiguration", "QLearningDiscreteDense",
+           "QLearningDiscreteConv", "A2CDiscreteDense"]

@@ -1,23 +1,26 @@
 """DQN — Q-learning with replay and target network.
 
 Reference analog: org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.
-QLearningDiscreteDense + QLConfiguration (epsilon-greedy with annealing,
-errorClamp, targetDqnUpdateFreq, doubleDQN flag). TPU-first: the entire
-update — batch forward through online+target nets, double-DQN TD target,
-Huber loss, Adam step — is one jitted XLA program with donated params.
+QLearningDiscreteDense / QLearningDiscreteConv + QLConfiguration
+(epsilon-greedy with annealing, errorClamp, targetDqnUpdateFreq, doubleDQN
+flag), with the dueling-architecture and n-step-return options of the era's
+DQN lineage. TPU-first: the entire update — batch forward through
+online+target nets, double-DQN TD target, Huber loss, Adam step — is one
+jitted XLA program with donated params; the conv variant feeds NHWC frame
+stacks straight to the MXU via lax.conv.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.rl.env import MDP
-from deeplearning4j_tpu.rl.replay import ExpReplay
+from deeplearning4j_tpu.rl.replay import ExpReplay, NStepAccumulator
 
 
 def _mlp_init(key, sizes):
@@ -37,56 +40,116 @@ def _mlp_apply(params, x):
     return x
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("gamma", "lr", "double_dqn", "error_clamp"))
-def _dqn_step(params, opt, target_params, obs, actions, rewards, next_obs,
-              dones, gamma, lr, double_dqn, error_clamp):
-    def loss_fn(p):
-        q = _mlp_apply(p, obs)                                   # [B, A]
-        q_sa = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
-        q_next_t = _mlp_apply(target_params, next_obs)
-        if double_dqn:
-            a_star = jnp.argmax(_mlp_apply(p, next_obs), axis=1)
-            q_next = jnp.take_along_axis(q_next_t, a_star[:, None], axis=1)[:, 0]
-        else:
-            q_next = q_next_t.max(axis=1)
-        target = rewards + gamma * (1.0 - dones) * jax.lax.stop_gradient(q_next)
-        td = q_sa - target
-        if error_clamp > 0:  # Huber (the reference's errorClamp)
-            abs_td = jnp.abs(td)
-            loss = jnp.where(abs_td <= error_clamp,
-                             0.5 * td ** 2,
-                             error_clamp * (abs_td - 0.5 * error_clamp))
-        else:
-            loss = 0.5 * td ** 2
-        return loss.mean()
+def _dense_net(obs_size: int, hidden: Sequence[int], n_actions: int,
+               dueling: bool):
+    """(init, apply) for the dense Q-net; apply returns [B, A] Q-values."""
 
-    loss, grads = jax.value_and_grad(loss_fn)(params)
-    # Adam
-    new_params, new_opt = [], []
+    def init(key):
+        trunk = _mlp_init(key, [obs_size, *hidden])
+        h = hidden[-1]
+        heads = _dueling_heads_init(jax.random.fold_in(key, 1000), h,
+                                    n_actions, dueling)
+        return {"trunk": trunk, **heads}
+
+    def apply(p, x):
+        h = jax.nn.relu(_mlp_apply(p["trunk"], x))
+        return _dueling_heads_apply(p, h, dueling)
+
+    return init, apply
+
+
+def _conv_net(obs_shape: Tuple[int, int, int], channels: Sequence[int],
+              dense: int, n_actions: int, dueling: bool):
+    """(init, apply) for the pixel Q-net: 3x3 stride-2 conv stack (NHWC)
+    -> flatten -> dense -> Q heads. The reference's conv topology is the
+    DQN-Nature stack; strided 3x3s keep the same receptive-field growth
+    while staying friendly to small test frames."""
+
+    def init(key):
+        params = {"conv": []}
+        c_in = obs_shape[-1]
+        h, w = obs_shape[0], obs_shape[1]
+        for i, c_out in enumerate(channels):
+            k = jax.random.fold_in(key, i)
+            fan_in = 3 * 3 * c_in
+            params["conv"].append({
+                "W": jax.random.normal(k, (3, 3, c_in, c_out))
+                * jnp.sqrt(2.0 / fan_in),
+                "b": jnp.zeros(c_out)})
+            c_in = c_out
+            h, w = (h + 1) // 2, (w + 1) // 2
+        flat = h * w * c_in
+        kd = jax.random.fold_in(key, 500)
+        params["dense"] = {"W": jax.random.normal(kd, (flat, dense))
+                           * jnp.sqrt(2.0 / flat),
+                           "b": jnp.zeros(dense)}
+        params.update(_dueling_heads_init(jax.random.fold_in(key, 1000),
+                                          dense, n_actions, dueling))
+        return params
+
+    def apply(p, x):
+        for layer in p["conv"]:
+            x = jax.lax.conv_general_dilated(
+                x, layer["W"], window_strides=(2, 2), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+            x = jax.nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        h = jax.nn.relu(x @ p["dense"]["W"] + p["dense"]["b"])
+        return _dueling_heads_apply(p, h, dueling)
+
+    return init, apply
+
+
+def _dueling_heads_init(key, h: int, n_actions: int, dueling: bool):
+    k1, k2 = jax.random.split(key)
+    if not dueling:
+        return {"q": {"W": jax.random.normal(k1, (h, n_actions))
+                      * jnp.sqrt(2.0 / h),
+                      "b": jnp.zeros(n_actions)}}
+    return {"adv": {"W": jax.random.normal(k1, (h, n_actions)) * 0.01,
+                    "b": jnp.zeros(n_actions)},
+            "val": {"W": jax.random.normal(k2, (h, 1)) * 0.01,
+                    "b": jnp.zeros(1)}}
+
+
+def _dueling_heads_apply(p, h, dueling: bool):
+    if not dueling:
+        return h @ p["q"]["W"] + p["q"]["b"]
+    adv = h @ p["adv"]["W"] + p["adv"]["b"]
+    val = h @ p["val"]["W"] + p["val"]["b"]
+    # Q = V + A - mean(A): the identifiability constraint from the dueling
+    # architecture; without it V/A are only determined up to a constant
+    return val + adv - adv.mean(axis=1, keepdims=True)
+
+
+def _adam_init(params):
+    return {"t": jnp.asarray(0),
+            "m": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+
+def _adam_update(params, opt, grads, lr):
     b1, b2, eps = 0.9, 0.999, 1e-8
     t = opt["t"] + 1
-    for pl, ml, vl, gl in zip(params, opt["m"], opt["v"], grads):
-        nm = {k: b1 * ml[k] + (1 - b1) * gl[k] for k in pl}
-        nv = {k: b2 * vl[k] + (1 - b2) * gl[k] ** 2 for k in pl}
-        upd = {k: lr * (nm[k] / (1 - b1 ** t)) /
-               (jnp.sqrt(nv[k] / (1 - b2 ** t)) + eps) for k in pl}
-        new_params.append({k: pl[k] - upd[k] for k in pl})
-        new_opt.append((nm, nv))
-    opt = {"t": t, "m": [o[0] for o in new_opt], "v": [o[1] for o in new_opt]}
-    return new_params, opt, loss
+    m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g,
+                               opt["m"], grads)
+    v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                               opt["v"], grads)
+    params = jax.tree_util.tree_map(
+        lambda p_, m_, v_: p_ - lr * (m_ / (1 - b1 ** t))
+        / (jnp.sqrt(v_ / (1 - b2 ** t)) + eps), params, m, v)
+    return params, {"t": t, "m": m, "v": v}
 
 
-class QLearningDiscreteDense:
-    """DQN trainer over an MDP (QLearningDiscreteDense analog)."""
+class _QLearningDiscrete:
+    """Shared DQN machinery; subclasses provide the Q-network."""
 
-    def __init__(self, mdp: MDP, hidden: List[int] = (64, 64),
-                 gamma: float = 0.99, lr: float = 1e-3,
-                 batch_size: int = 64, replay_capacity: int = 10000,
-                 min_replay: int = 200, target_update_freq: int = 100,
-                 eps_start: float = 1.0, eps_end: float = 0.05,
-                 eps_decay_steps: int = 2000, double_dqn: bool = True,
-                 error_clamp: float = 1.0, seed: int = 0):
+    def __init__(self, mdp: MDP, net, obs_shape, gamma: float, lr: float,
+                 batch_size: int, replay_capacity: int, min_replay: int,
+                 target_update_freq: int, eps_start: float, eps_end: float,
+                 eps_decay_steps: int, double_dqn: bool, error_clamp: float,
+                 n_step: int, seed: int):
+        init, apply = net
         self.mdp = mdp
         self.gamma = gamma
         self.lr = lr
@@ -97,27 +160,69 @@ class QLearningDiscreteDense:
         self.eps_decay_steps = eps_decay_steps
         self.double_dqn = double_dqn
         self.error_clamp = error_clamp
+        self.n_step = n_step
         self._rng = np.random.default_rng(seed)
-        sizes = [mdp.observation_size, *hidden, mdp.n_actions]
-        self.params = _mlp_init(jax.random.key(seed), sizes)
-        # real copy: params are donated into _dqn_step while target_params are
+        self._apply = apply
+        self.params = init(jax.random.key(seed))
+        # real copy: params are donated into the step while target_params are
         # passed by reference — aliased buffers would trip XLA donation checks
         self.target_params = jax.tree_util.tree_map(
             lambda x: jnp.array(x, copy=True), self.params)
-        self.opt = {"t": jnp.asarray(0),
-                    "m": [{k: jnp.zeros_like(v) for k, v in l.items()}
-                          for l in self.params],
-                    "v": [{k: jnp.zeros_like(v) for k, v in l.items()}
-                          for l in self.params]}
-        self.replay = ExpReplay(replay_capacity, mdp.observation_size, seed)
+        self.opt = _adam_init(self.params)
+        replay = ExpReplay(replay_capacity, obs_shape, seed)
+        self.replay = (replay if n_step == 1
+                       else NStepAccumulator(replay, n_step, gamma))
         self.step_count = 0
         self.episode_rewards: List[float] = []
-        self._q_fn = jax.jit(_mlp_apply)
+        self._q_fn = jax.jit(apply)
+        self._step_fn = self._build_step()
+
+    def _build_step(self):
+        apply = self._apply
+        # n-step backup bootstraps with gamma^n (rewards inside the window
+        # are pre-summed by NStepAccumulator)
+        gamma_n = self.gamma ** self.n_step
+        double_dqn, error_clamp, lr = (self.double_dqn, self.error_clamp,
+                                       self.lr)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt, target_params, obs, actions, rewards, next_obs,
+                 dones):
+            def loss_fn(p):
+                q = apply(p, obs)                                   # [B, A]
+                q_sa = jnp.take_along_axis(q, actions[:, None], axis=1)[:, 0]
+                q_next_t = apply(target_params, next_obs)
+                if double_dqn:
+                    a_star = jnp.argmax(apply(p, next_obs), axis=1)
+                    q_next = jnp.take_along_axis(
+                        q_next_t, a_star[:, None], axis=1)[:, 0]
+                else:
+                    q_next = q_next_t.max(axis=1)
+                target = rewards + gamma_n * (1.0 - dones) * \
+                    jax.lax.stop_gradient(q_next)
+                td = q_sa - target
+                if error_clamp > 0:  # Huber (the reference's errorClamp)
+                    abs_td = jnp.abs(td)
+                    loss = jnp.where(abs_td <= error_clamp,
+                                     0.5 * td ** 2,
+                                     error_clamp * (abs_td - 0.5 * error_clamp))
+                else:
+                    loss = 0.5 * td ** 2
+                return loss.mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, opt = _adam_update(params, opt, grads, lr)
+            return params, opt, loss
+
+        return step
 
     # ---------------------------------------------------------------- policy
     def epsilon(self) -> float:
         frac = min(1.0, self.step_count / self.eps_decay_steps)
         return self.eps_start + frac * (self.eps_end - self.eps_start)
+
+    def _observe(self, obs: np.ndarray) -> np.ndarray:
+        return obs
 
     def act(self, obs: np.ndarray, greedy: bool = False) -> int:
         if not greedy and self._rng.random() < self.epsilon():
@@ -127,24 +232,24 @@ class QLearningDiscreteDense:
 
     # ----------------------------------------------------------------- train
     def train_episode(self) -> float:
-        obs = self.mdp.reset()
+        raw = self.mdp.reset()
+        obs = self._observe(raw)
         total = 0.0
         done = False
         while not done:
             a = self.act(obs)
-            next_obs, r, done = self.mdp.step(a)
+            raw, r, done = self.mdp.step(a)
+            next_obs = self._observe(raw)
             self.replay.store(obs, a, r, next_obs, done)
             obs = next_obs
             total += r
             self.step_count += 1
             if len(self.replay) >= self.min_replay:
                 o, acts, rs, no, ds = self.replay.sample(self.batch_size)
-                self.params, self.opt, _ = _dqn_step(
+                self.params, self.opt, _ = self._step_fn(
                     self.params, self.opt, self.target_params,
                     jnp.asarray(o), jnp.asarray(acts), jnp.asarray(rs),
-                    jnp.asarray(no), jnp.asarray(ds),
-                    gamma=self.gamma, lr=self.lr, double_dqn=self.double_dqn,
-                    error_clamp=self.error_clamp)
+                    jnp.asarray(no), jnp.asarray(ds))
             if self.step_count % self.target_update_freq == 0:
                 self.target_params = jax.tree_util.tree_map(
                     lambda x: jnp.array(x, copy=True), self.params)
@@ -156,9 +261,67 @@ class QLearningDiscreteDense:
 
     def play_episode(self) -> float:
         """Greedy rollout (Policy.play analog)."""
-        obs = self.mdp.reset()
+        raw = self.mdp.reset()
+        obs = self._observe(raw)
         total, done = 0.0, False
         while not done:
-            obs, r, done = self.mdp.step(self.act(obs, greedy=True))
+            raw, r, done = self.mdp.step(self.act(obs, greedy=True))
+            obs = self._observe(raw)
             total += r
         return total
+
+
+class QLearningDiscreteDense(_QLearningDiscrete):
+    """DQN trainer over a vector-observation MDP."""
+
+    def __init__(self, mdp: MDP, hidden: List[int] = (64, 64),
+                 gamma: float = 0.99, lr: float = 1e-3,
+                 batch_size: int = 64, replay_capacity: int = 10000,
+                 min_replay: int = 200, target_update_freq: int = 100,
+                 eps_start: float = 1.0, eps_end: float = 0.05,
+                 eps_decay_steps: int = 2000, double_dqn: bool = True,
+                 error_clamp: float = 1.0, dueling: bool = False,
+                 n_step: int = 1, seed: int = 0):
+        net = _dense_net(mdp.observation_size, list(hidden), mdp.n_actions,
+                         dueling)
+        super().__init__(mdp, net, mdp.observation_size, gamma, lr,
+                         batch_size, replay_capacity, min_replay,
+                         target_update_freq, eps_start, eps_end,
+                         eps_decay_steps, double_dqn, error_clamp, n_step,
+                         seed)
+
+
+class QLearningDiscreteConv(_QLearningDiscrete):
+    """DQN trainer over pixel observations through a HistoryProcessor
+    (QLearningDiscreteConv + IHistoryProcessor analog): raw frames are
+    rescaled/stacked host-side, the stacked [H, W, history] tensor is the
+    Q-net input."""
+
+    def __init__(self, mdp: MDP, history_processor,
+                 channels: Sequence[int] = (16, 32), dense: int = 128,
+                 gamma: float = 0.99, lr: float = 1e-3,
+                 batch_size: int = 32, replay_capacity: int = 5000,
+                 min_replay: int = 100, target_update_freq: int = 100,
+                 eps_start: float = 1.0, eps_end: float = 0.05,
+                 eps_decay_steps: int = 2000, double_dqn: bool = True,
+                 error_clamp: float = 1.0, dueling: bool = False,
+                 n_step: int = 1, seed: int = 0):
+        self.history = history_processor
+        obs_shape = history_processor.output_shape
+        net = _conv_net(obs_shape, list(channels), dense, mdp.n_actions,
+                        dueling)
+        super().__init__(mdp, net, obs_shape, gamma, lr, batch_size,
+                         replay_capacity, min_replay, target_update_freq,
+                         eps_start, eps_end, eps_decay_steps, double_dqn,
+                         error_clamp, n_step, seed)
+
+    def _observe(self, obs: np.ndarray) -> np.ndarray:
+        return self.history.observe(obs)
+
+    def train_episode(self) -> float:
+        self.history.reset()
+        return super().train_episode()
+
+    def play_episode(self) -> float:
+        self.history.reset()
+        return super().play_episode()

@@ -25,6 +25,70 @@ class MDP:
         raise NotImplementedError
 
 
+class FrameSkipWrapper(MDP):
+    """Action-repeat wrapper (the reference's skipFrame semantics): each
+    agent-visible step repeats the action ``skip`` times, summing rewards."""
+
+    def __init__(self, mdp: MDP, skip: int):
+        if skip < 1:
+            raise ValueError("skip must be >= 1")
+        self.mdp = mdp
+        self.skip = skip
+        self.observation_size = getattr(mdp, "observation_size", None)
+        self.n_actions = mdp.n_actions
+
+    def reset(self):
+        return self.mdp.reset()
+
+    def step(self, action: int):
+        total, done = 0.0, False
+        obs = None
+        for _ in range(self.skip):
+            obs, r, done = self.mdp.step(action)
+            total += r
+            if done:
+                break
+        return obs, total, done
+
+
+class PixelGridWorld(MDP):
+    """Tiny pixel-observation MDP for conv Q-learning tests: the agent is a
+    bright pixel on a dark [size, size] frame, actions move it left/right
+    along the middle row, reaching the right edge pays +1 and ends the
+    episode (a no-egress stand-in for the reference's ALE/Malmo pixel MDPs).
+    """
+
+    def __init__(self, size: int = 12, max_steps: int = 40, seed: int = 0):
+        self.size = size
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self.n_actions = 2
+        self._pos = 0
+        self._steps = 0
+
+    @property
+    def frame_shape(self):
+        return (self.size, self.size)
+
+    def _frame(self) -> np.ndarray:
+        f = np.zeros((self.size, self.size), np.float32)
+        f[self.size // 2, self._pos] = 1.0
+        return f
+
+    def reset(self) -> np.ndarray:
+        self._pos = int(self._rng.integers(0, self.size // 2))
+        self._steps = 0
+        return self._frame()
+
+    def step(self, action: int):
+        self._pos = min(self.size - 1, max(0, self._pos + (1 if action == 1
+                                                           else -1)))
+        self._steps += 1
+        reached = self._pos == self.size - 1
+        done = reached or self._steps >= self.max_steps
+        return self._frame(), (1.0 if reached else -0.01), done
+
+
 class CartPole(MDP):
     """Classic cart-pole balancing (the CartPole-v0 dynamics)."""
 

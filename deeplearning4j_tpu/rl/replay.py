@@ -1,22 +1,28 @@
 """Experience replay buffer.
 
 Reference analog: org.deeplearning4j.rl4j.learning.sync.ExpReplay — circular
-transition store with uniform minibatch sampling.
+transition store with uniform minibatch sampling. Generalized here to
+arbitrary observation shapes (dense vectors or stacked pixel frames), plus
+an n-step transition accumulator (the AsyncNStepQLearning reward-accumulation
+idea as a synchronous, replay-compatible component).
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from collections import deque
+from typing import Tuple, Union
 
 import numpy as np
 
 
 class ExpReplay:
-    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_size: Union[int, Tuple[int, ...]],
+                 seed: int = 0):
+        obs_shape = (obs_size,) if isinstance(obs_size, int) else obs_size
         self.capacity = capacity
         self._rng = np.random.default_rng(seed)
-        self.obs = np.zeros((capacity, obs_size), np.float32)
-        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.obs = np.zeros((capacity, *obs_shape), np.float32)
+        self.next_obs = np.zeros((capacity, *obs_shape), np.float32)
         self.actions = np.zeros(capacity, np.int32)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.float32)
@@ -40,3 +46,42 @@ class ExpReplay:
         idx = self._rng.integers(0, self._n, size=batch_size)
         return (self.obs[idx], self.actions[idx], self.rewards[idx],
                 self.next_obs[idx], self.dones[idx])
+
+
+class NStepAccumulator:
+    """Converts 1-step transitions into n-step ones before replay storage.
+
+    Emitted transitions are (obs_t, a_t, sum_{k=0..n-1} gamma^k r_{t+k},
+    obs_{t+n}, done); the TD backup then bootstraps with gamma^n (the
+    trainer owns that exponent). On episode end, all pending transitions
+    flush with their shortened-horizon returns, matching the reference's
+    n-step accumulation at episode boundaries.
+    """
+
+    def __init__(self, replay: ExpReplay, n_step: int, gamma: float):
+        if n_step < 1:
+            raise ValueError("n_step must be >= 1")
+        self.replay = replay
+        self.n_step = n_step
+        self.gamma = gamma
+        self._pending: deque = deque()
+
+    def store(self, obs, action, reward, next_obs, done):
+        self._pending.append([obs, action, 0.0, 0, next_obs, done])
+        # fold this reward into every pending transition's partial return
+        for entry in self._pending:
+            entry[2] += (self.gamma ** entry[3]) * reward
+            entry[3] += 1
+            entry[4] = next_obs
+            entry[5] = done
+        while self._pending and (self._pending[0][3] >= self.n_step or done):
+            o, a, g, _, no, d = self._pending.popleft()
+            self.replay.store(o, a, g, no, d)
+        if done:
+            self._pending.clear()
+
+    def sample(self, batch_size: int) -> Tuple[np.ndarray, ...]:
+        return self.replay.sample(batch_size)
+
+    def __len__(self):
+        return len(self.replay)

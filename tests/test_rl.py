@@ -75,3 +75,104 @@ class TestA2C:
         a2c.train(40)
         # greedy policy clearly beats the ~20-step random baseline
         assert a2c.play_episode() > 40
+
+
+class TestHistoryProcessor:
+    def test_stack_and_rescale(self):
+        from deeplearning4j_tpu.rl import HistoryProcessor
+        hp = HistoryProcessor(history_length=3, rescaled_height=4,
+                              rescaled_width=4)
+        f0 = np.zeros((8, 8), np.float32)
+        f0[0, 0] = 1.0
+        out = hp.observe(f0)
+        assert out.shape == (4, 4, 3)
+        # startup padding repeats the first frame
+        assert np.array_equal(out[..., 0], out[..., 2])
+        f1 = np.ones((8, 8), np.float32)
+        out = hp.observe(f1)
+        assert out[..., -1].mean() == 1.0        # newest frame last
+        assert out[..., 0].mean() < 1.0          # older frame retained
+        assert hp.output_shape == (4, 4, 3)
+
+    def test_crop_and_grayscale(self):
+        from deeplearning4j_tpu.rl import HistoryProcessor
+        hp = HistoryProcessor(history_length=1, crop_top=2, crop_bottom=2,
+                              crop_left=1, crop_right=1)
+        rgb = np.zeros((8, 6, 3), np.float32)
+        rgb[..., 0] = 3.0  # gray = mean = 1.0
+        out = hp.observe(rgb)
+        assert out.shape == (4, 4, 1)
+        assert np.allclose(out, 1.0)
+
+    def test_reset_clears_stack(self):
+        from deeplearning4j_tpu.rl import HistoryProcessor
+        hp = HistoryProcessor(history_length=2)
+        hp.observe(np.zeros((4, 4), np.float32))
+        hp.observe(np.ones((4, 4), np.float32))
+        hp.reset()
+        out = hp.observe(np.full((4, 4), 0.5, np.float32))
+        assert np.allclose(out, 0.5)  # padding from the fresh frame only
+
+
+class TestNStepReplay:
+    def test_accumulates_discounted_rewards(self):
+        from deeplearning4j_tpu.rl import ExpReplay, NStepAccumulator
+        buf = ExpReplay(capacity=16, obs_size=1, seed=0)
+        acc = NStepAccumulator(buf, n_step=3, gamma=0.5)
+        # rewards 1,2,4,8 then done
+        for t, (r, done) in enumerate([(1, False), (2, False), (4, False),
+                                       (8, True)]):
+            acc.store([t], 0, r, [t + 1], done)
+        assert len(buf) == 4
+        # transition 0: 1 + 0.5*2 + 0.25*4 = 3, next_obs = obs_3
+        assert buf.rewards[0] == pytest.approx(3.0)
+        assert buf.next_obs[0, 0] == 3.0
+        assert buf.dones[0] == 0.0
+        # transition 1 (flushed by done): 2 + 0.5*4 + 0.25*8 = 6, done
+        assert buf.rewards[1] == pytest.approx(6.0)
+        assert buf.dones[1] == 1.0
+        # tail transitions flush with shortened horizons
+        assert buf.rewards[3] == pytest.approx(8.0)
+
+    def test_pending_cleared_between_episodes(self):
+        from deeplearning4j_tpu.rl import ExpReplay, NStepAccumulator
+        buf = ExpReplay(capacity=16, obs_size=1, seed=0)
+        acc = NStepAccumulator(buf, n_step=3, gamma=1.0)
+        acc.store([0], 0, 1.0, [1], True)
+        acc.store([10], 0, 5.0, [11], False)
+        assert len(buf) == 1
+        assert buf.rewards[0] == 1.0  # second episode's reward not mixed in
+
+
+class TestDuelingAndConv:
+    def test_dueling_dense_learns_cartpole(self):
+        ql = QLearningDiscreteDense(
+            CartPole(seed=1, max_steps=120), hidden=[64], lr=2e-3,
+            min_replay=300, target_update_freq=200, eps_decay_steps=2000,
+            dueling=True, n_step=3, seed=3)
+        rews = ql.train(150)
+        first, last = np.mean(rews[:20]), np.mean(rews[-20:])
+        assert last > 1.8 * first, (first, last)
+
+    def test_conv_pixel_learning(self):
+        from deeplearning4j_tpu.rl import (HistoryProcessor, PixelGridWorld,
+                                           QLearningDiscreteConv)
+        env = PixelGridWorld(size=8, max_steps=30, seed=0)
+        hp = HistoryProcessor(history_length=2).set_input_shape(8, 8)
+        ql = QLearningDiscreteConv(
+            env, hp, channels=(8,), dense=32, lr=2e-3, batch_size=32,
+            min_replay=64, target_update_freq=100, eps_decay_steps=600,
+            dueling=True, seed=0)
+        rews = ql.train(60)
+        # optimal play reaches the goal: late episodes mostly succeed
+        late = rews[-15:]
+        assert np.mean([r > 0.5 for r in late]) > 0.6, late
+        assert ql.play_episode() > 0.5
+
+    def test_frame_skip_wrapper(self):
+        from deeplearning4j_tpu.rl import FrameSkipWrapper, PixelGridWorld
+        env = FrameSkipWrapper(PixelGridWorld(size=8, max_steps=30, seed=0),
+                               skip=2)
+        env.reset()
+        obs, r, done = env.step(1)
+        assert obs.shape == (8, 8)  # two raw steps happened inside
