@@ -542,6 +542,42 @@ def bench_kernels(rounds=3, budget_deadline=None):
         if not over_deadline():
             rows("B256_H1024", 256, 64, 512, 1024, 60)  # demoted (nj>1)
 
+    # ---- fused GRU: same regimes as the LSTM (3-gate cell, same policy)
+    def gru_rows():
+        from deeplearning4j_tpu.ops.pallas.fused_gru import fused_gru_layer
+        from deeplearning4j_tpu.ops.recurrent import gru_layer
+
+        def rows(tag, B, T, F, H, iters):
+            x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+            h0 = jnp.zeros((B, H))
+            W = jnp.asarray(rng.normal(size=(F, 3 * H)).astype(np.float32) * .05)
+            R = jnp.asarray(rng.normal(size=(H, 3 * H)).astype(np.float32) * .05)
+            b = jnp.zeros((3 * H,))
+
+            def fwd(fn):
+                def step(acc):
+                    out, _ = fn(x + acc * 1e-12, h0, W, R, b)
+                    return out.mean()
+                return step
+
+            def train(fn):
+                def step(acc):
+                    def loss(WW):
+                        return fn(x, h0, WW, R, b)[0].sum()
+                    return jax.grad(loss)(W + acc * 1e-16).mean()
+                return step
+
+            table[f"fused_gru_fwd_{tag}"] = _device_loop_ab(
+                lambda: fwd(fused_gru_layer), lambda: fwd(gru_layer),
+                iters=iters, rounds=rounds)
+            table[f"fused_gru_train_{tag}"] = _device_loop_ab(
+                lambda: train(fused_gru_layer), lambda: train(gru_layer),
+                iters=iters, rounds=rounds)
+
+        rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
+        if not over_deadline():
+            rows("B256_H1024", 256, 64, 512, 1024, 60)  # multi-tile check
+
     # ---- LRN, AlexNet conv2 shape. The impl fns are captured at BUILD
     # time (pallas_lrn directly vs the registered xla lowering) — selecting
     # through the registry inside the jitted step would read the env flags
@@ -575,7 +611,7 @@ def bench_kernels(rounds=3, budget_deadline=None):
             build_train(pallas_lrn), build_train(xla_lrn), iters=400,
             rounds=rounds)
 
-    for block in (flash_rows, lstm_rows, lrn_rows):
+    for block in (flash_rows, lstm_rows, gru_rows, lrn_rows):
         if over_deadline():
             table["truncated"] = "deadline reached; remaining kernels skipped"
             break

@@ -36,6 +36,8 @@ class Environment:
     # Force the fused LSTM to take the scan-recompute backward instead of
     # the Pallas backward kernel (A/B measurement + escape hatch).
     LSTM_SCAN_BWD = "DL4J_TPU_LSTM_SCAN_BWD"
+    # Same escape hatch for the fused GRU backward.
+    GRU_SCAN_BWD = "DL4J_TPU_GRU_SCAN_BWD"
 
     def __init__(self) -> None:
         self.reload()
@@ -47,6 +49,7 @@ class Environment:
         self.verbose = _flag(self.VERBOSE)
         self.profiling = _flag(self.PROFILING)
         self.lstm_scan_bwd = _flag(self.LSTM_SCAN_BWD)
+        self.gru_scan_bwd = _flag(self.GRU_SCAN_BWD)
 
 
 env = Environment()

@@ -10,6 +10,8 @@ for. Importing this package performs the registration.
 
 from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
 from deeplearning4j_tpu.ops.pallas.fused_lstm import fused_lstm_layer
+from deeplearning4j_tpu.ops.pallas.fused_gru import fused_gru_layer
 from deeplearning4j_tpu.ops.pallas.lrn import pallas_lrn
 
-__all__ = ["flash_attention", "fused_lstm_layer", "pallas_lrn"]
+__all__ = ["flash_attention", "fused_lstm_layer", "fused_gru_layer",
+           "pallas_lrn"]

@@ -13,7 +13,8 @@ threads busy, not for learning quality).
 
 from deeplearning4j_tpu.rl.env import (CartPole, FrameSkipWrapper, MDP,
                                        PixelGridWorld)
-from deeplearning4j_tpu.rl.replay import ExpReplay, NStepAccumulator
+from deeplearning4j_tpu.rl.replay import (ExpReplay, FrameStackReplay,
+                                          NStepAccumulator)
 from deeplearning4j_tpu.rl.history import (HistoryConfiguration,
                                            HistoryProcessor)
 from deeplearning4j_tpu.rl.dqn import (QLearningDiscreteConv,
@@ -21,6 +22,6 @@ from deeplearning4j_tpu.rl.dqn import (QLearningDiscreteConv,
 from deeplearning4j_tpu.rl.actor_critic import A2CDiscreteDense
 
 __all__ = ["MDP", "CartPole", "PixelGridWorld", "FrameSkipWrapper",
-           "ExpReplay", "NStepAccumulator", "HistoryProcessor",
+           "ExpReplay", "FrameStackReplay", "NStepAccumulator", "HistoryProcessor",
            "HistoryConfiguration", "QLearningDiscreteDense",
            "QLearningDiscreteConv", "A2CDiscreteDense"]

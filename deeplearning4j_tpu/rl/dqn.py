@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.optimize.updaters import Adam
 from deeplearning4j_tpu.rl.env import MDP
 from deeplearning4j_tpu.rl.replay import ExpReplay, NStepAccumulator
 
@@ -122,25 +123,6 @@ def _dueling_heads_apply(p, h, dueling: bool):
     return val + adv - adv.mean(axis=1, keepdims=True)
 
 
-def _adam_init(params):
-    return {"t": jnp.asarray(0),
-            "m": jax.tree_util.tree_map(jnp.zeros_like, params),
-            "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
-
-
-def _adam_update(params, opt, grads, lr):
-    b1, b2, eps = 0.9, 0.999, 1e-8
-    t = opt["t"] + 1
-    m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g,
-                               opt["m"], grads)
-    v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
-                               opt["v"], grads)
-    params = jax.tree_util.tree_map(
-        lambda p_, m_, v_: p_ - lr * (m_ / (1 - b1 ** t))
-        / (jnp.sqrt(v_ / (1 - b2 ** t)) + eps), params, m, v)
-    return params, {"t": t, "m": m, "v": v}
-
-
 class _QLearningDiscrete:
     """Shared DQN machinery; subclasses provide the Q-network."""
 
@@ -168,8 +150,10 @@ class _QLearningDiscrete:
         # passed by reference — aliased buffers would trip XLA donation checks
         self.target_params = jax.tree_util.tree_map(
             lambda x: jnp.array(x, copy=True), self.params)
-        self.opt = _adam_init(self.params)
-        replay = ExpReplay(replay_capacity, obs_shape, seed)
+        self._updater = Adam(lr=lr)
+        self.opt = {"step": jnp.asarray(0),
+                    "state": self._updater.init_state(self.params)}
+        replay = self._make_buffer(replay_capacity, obs_shape, seed)
         self.replay = (replay if n_step == 1
                        else NStepAccumulator(replay, n_step, gamma))
         self.step_count = 0
@@ -177,13 +161,16 @@ class _QLearningDiscrete:
         self._q_fn = jax.jit(apply)
         self._step_fn = self._build_step()
 
+    def _make_buffer(self, capacity, obs_shape, seed):
+        return ExpReplay(capacity, obs_shape, seed)
+
     def _build_step(self):
         apply = self._apply
         # n-step backup bootstraps with gamma^n (rewards inside the window
         # are pre-summed by NStepAccumulator)
         gamma_n = self.gamma ** self.n_step
-        double_dqn, error_clamp, lr = (self.double_dqn, self.error_clamp,
-                                       self.lr)
+        double_dqn, error_clamp = self.double_dqn, self.error_clamp
+        updater = self._updater
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt, target_params, obs, actions, rewards, next_obs,
@@ -211,8 +198,10 @@ class _QLearningDiscrete:
                 return loss.mean()
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            params, opt = _adam_update(params, opt, grads, lr)
-            return params, opt, loss
+            upd, new_state = updater.update(grads, opt["state"], params,
+                                            opt["step"])
+            params = jax.tree_util.tree_map(lambda p_, u: p_ - u, params, upd)
+            return params, {"step": opt["step"] + 1, "state": new_state}, loss
 
         return step
 
@@ -314,6 +303,12 @@ class QLearningDiscreteConv(_QLearningDiscrete):
                          replay_capacity, min_replay, target_update_freq,
                          eps_start, eps_end, eps_decay_steps, double_dqn,
                          error_clamp, n_step, seed)
+
+    def _make_buffer(self, capacity, obs_shape, seed):
+        # frame-ring store: one copy per raw frame instead of 2*history
+        # stacked copies per transition (the DQN-Nature replay layout)
+        from deeplearning4j_tpu.rl.replay import FrameStackReplay
+        return FrameStackReplay(capacity, obs_shape[:-1], obs_shape[-1], seed)
 
     def _observe(self, obs: np.ndarray) -> np.ndarray:
         return self.history.observe(obs)

@@ -48,6 +48,123 @@ class ExpReplay:
                 self.next_obs[idx], self.dones[idx])
 
 
+class FrameStackReplay:
+    """Frame-ring replay for pixel observations: each raw processed frame is
+    stored ONCE and observation stacks are reassembled at sample time — the
+    DQN-Nature memory layout. A stacked [H, W, k] float32 store duplicates
+    every frame 2k times; this keeps one copy per step (plus one terminal
+    frame per episode), cutting pixel replay memory ~8x at history 4.
+
+    Drop-in for ExpReplay in the conv trainer: ``store`` takes the SAME
+    (obs_stack, action, reward, next_stack, done) arguments and strips the
+    newest frame from each stack internally; ``sample`` returns stacked
+    [B, H, W, k] observations identical to what was stored.
+
+    ``frame_dtype``: np.float32 default; pass np.uint8 for byte-valued
+    frames (ALE-style) to cut memory another 4x.
+    """
+
+    def __init__(self, capacity, frame_shape, history_length: int,
+                 seed: int = 0, frame_dtype=np.float32):
+        self.capacity = capacity
+        self.k = history_length
+        self._rng = np.random.default_rng(seed)
+        self.frames = np.zeros((capacity, *frame_shape), frame_dtype)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        # per-slot episode id and step-within-episode; has_transition is
+        # False for the extra terminal-frame slot pushed at episode end
+        self.ep = np.full(capacity, -1, np.int64)
+        self.t_in_ep = np.zeros(capacity, np.int64)
+        self.has_transition = np.zeros(capacity, bool)
+        self._pos = 0
+        self._n = 0
+        self._ep_id = 0
+        self._new_episode = True
+        self._count = 0  # transitions stored
+
+    def __len__(self):
+        return self._count
+
+    def _push(self, frame, ep, t, action=0, reward=0.0, done=False,
+              has_transition=False):
+        i = self._pos
+        if self.has_transition[i]:
+            self._count -= 1          # overwriting an old transition
+        self.frames[i] = frame
+        self.actions[i] = action
+        self.rewards[i] = reward
+        self.dones[i] = float(done)
+        self.ep[i] = ep
+        self.t_in_ep[i] = t
+        self.has_transition[i] = has_transition
+        self._pos = (self._pos + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+        if has_transition:
+            self._count += 1
+
+    def store(self, obs, action, reward, next_obs, done):
+        f_t = np.asarray(obs)[..., -1]
+        t = 0 if self._new_episode else self._t_next
+        self._push(f_t, self._ep_id, t, action, reward, done,
+                   has_transition=True)
+        self._new_episode = False
+        self._t_next = t + 1
+        if done:
+            # terminal frame slot so the last transition's next-stack exists
+            self._push(np.asarray(next_obs)[..., -1], self._ep_id, t + 1)
+            self._ep_id += 1
+            self._new_episode = True
+
+    def _stack_ending_at(self, i):
+        """[H, W, k] stack whose newest frame is slot i, left-padded by
+        repeating the earliest same-episode frame."""
+        idxs = [i]
+        cur = i
+        for _ in range(self.k - 1):
+            prev = (cur - 1) % self.capacity
+            if (self._n == self.capacity or prev < cur) and \
+               self.ep[prev] == self.ep[cur] and \
+               self.t_in_ep[prev] == self.t_in_ep[cur] - 1:
+                idxs.append(prev)
+                cur = prev
+            else:
+                idxs.append(cur)      # repeat earliest episode frame
+        idxs.reverse()
+        return np.stack([self.frames[j].astype(np.float32) for j in idxs],
+                        axis=-1)
+
+    def _valid(self, i):
+        if not self.has_transition[i]:
+            return False
+        nxt = (i + 1) % self.capacity
+        # the successor slot must still be this episode's next step (it may
+        # have been overwritten by the ring, or not written yet)
+        return (self.ep[nxt] == self.ep[i]
+                and self.t_in_ep[nxt] == self.t_in_ep[i] + 1)
+
+    def sample(self, batch_size: int) -> Tuple[np.ndarray, ...]:
+        obs, actions, rewards, next_obs, dones = [], [], [], [], []
+        tries = 0
+        while len(obs) < batch_size:
+            i = int(self._rng.integers(0, self._n))
+            tries += 1
+            if tries > 200 * batch_size:
+                raise RuntimeError("FrameStackReplay: not enough valid "
+                                   "transitions to sample from")
+            if not self._valid(i):
+                continue
+            obs.append(self._stack_ending_at(i))
+            next_obs.append(self._stack_ending_at((i + 1) % self.capacity))
+            actions.append(self.actions[i])
+            rewards.append(self.rewards[i])
+            dones.append(self.dones[i])
+        return (np.stack(obs), np.asarray(actions, np.int32),
+                np.asarray(rewards, np.float32), np.stack(next_obs),
+                np.asarray(dones, np.float32))
+
+
 class NStepAccumulator:
     """Converts 1-step transitions into n-step ones before replay storage.
 

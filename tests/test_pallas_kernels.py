@@ -532,3 +532,174 @@ class TestFusedLSTMUnalignedHidden:
         assert op.select(x, h0, c0, jnp.zeros((16, 800)),
                          jnp.zeros((200, 800)),
                          jnp.zeros((800,))).platform == "pallas"
+
+
+class TestFusedGRU:
+    """Fused GRU kernel (CUDNN_GRU-mode analog) vs the scan lowering —
+    forward parity, full-argnum gradient parity (backward kernel), tiling,
+    padding, selection."""
+
+    def _mk(self, rng, B, T, F, H, scale=0.1):
+        from deeplearning4j_tpu.ops.recurrent import gru_layer  # noqa: F401
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32) * scale)
+        W = jnp.asarray(rng.normal(size=(F, 3 * H)).astype(np.float32) * scale)
+        R = jnp.asarray(rng.normal(size=(H, 3 * H)).astype(np.float32) * scale)
+        b = jnp.asarray(rng.normal(size=(3 * H,)).astype(np.float32) * scale)
+        return x, h0, W, R, b
+
+    @pytest.mark.parametrize("reverse", [False, True])
+    def test_matches_scan(self, rng, reverse):
+        from deeplearning4j_tpu.ops.pallas import fused_gru_layer
+        from deeplearning4j_tpu.ops.recurrent import gru_layer
+        x, h0, W, R, b = self._mk(rng, 4, 6, 8, 128)
+        ok, hk = fused_gru_layer(x, h0, W, R, b, reverse=reverse)
+        os_, hs = gru_layer(x, h0, W, R, b, reverse=reverse)
+        np.testing.assert_allclose(np.asarray(ok), np.asarray(os_),
+                                   rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(hk), np.asarray(hs),
+                                   rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("reverse", [False, True])
+    def test_all_argnum_grads_match_scan(self, rng, reverse):
+        from deeplearning4j_tpu.ops.pallas import fused_gru_layer
+        from deeplearning4j_tpu.ops.recurrent import gru_layer
+        B, T, F, H = 8, 5, 8, 128
+        x, h0, W, R, b = self._mk(rng, B, T, F, H)
+        wseq = jnp.asarray(rng.normal(size=(B, T, H)).astype(np.float32))
+
+        def loss(fn, *args):
+            out, hT = fn(*args, reverse=reverse)
+            return (out * wseq).sum() + 0.5 * (hT ** 2).sum()
+
+        argnums = tuple(range(5))
+        gk = jax.grad(lambda *a: loss(fused_gru_layer, *a), argnums)(
+            x, h0, W, R, b)
+        gs = jax.grad(lambda *a: loss(gru_layer, *a), argnums)(
+            x, h0, W, R, b)
+        for name, a, b_ in zip(("x", "h0", "W", "R", "b"), gk, gs):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5,
+                err_msg=f"d{name} reverse={reverse}")
+
+    def test_bwd_is_kernel_not_recompute(self, monkeypatch):
+        import deeplearning4j_tpu.ops.pallas.fused_gru as fg
+
+        called = []
+        orig = fg._bwd_recurrence
+
+        def spy(*a, **kw):
+            called.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fg, "_bwd_recurrence", spy)
+        x = jnp.ones((8, 3, 8), jnp.float32)
+        h0 = jnp.zeros((8, 128))
+        W = jnp.ones((8, 384), jnp.float32) * 0.01
+        R = jnp.ones((128, 384), jnp.float32) * 0.01
+        b = jnp.zeros((384,))
+        jax.grad(lambda W: fg.fused_gru_layer(x, h0, W, R, b)[0].sum())(W)
+        assert called, "GRU backward kernel was not used in the vjp"
+
+    def test_hidden_tiled_parity(self, rng):
+        """nj > 1 (H=256 with a forced 128 tile) — cross-slice dh coupling
+        in the backward (the GRU-specific hazard: dh0 and the dh carry mix
+        full-H matmul contributions with per-slice direct terms)."""
+        import deeplearning4j_tpu.ops.pallas.fused_gru as fg
+        from deeplearning4j_tpu.ops.recurrent import gru_layer
+
+        B, T, F, H = 8, 4, 8, 256
+        x, h0, W, R, b = self._mk(rng, B, T, F, H, scale=0.05)
+        orig_f, orig_b = fg.gru_tile, fg.gru_bwd_tile
+        try:
+            fg.gru_tile = lambda *a, **k: 128
+            fg.gru_bwd_tile = lambda *a, **k: 128
+            gk = jax.grad(lambda args: (
+                fg.fused_gru_layer(args[0], args[1], W, args[2], b)[0].sum()
+                + fg.fused_gru_layer(args[0], args[1], W, args[2],
+                                     b)[1].sum()))((x, h0, R))
+        finally:
+            fg.gru_tile, fg.gru_bwd_tile = orig_f, orig_b
+        gs = jax.grad(lambda args: (
+            gru_layer(args[0], args[1], W, args[2], b)[0].sum()
+            + gru_layer(args[0], args[1], W, args[2], b)[1].sum()))((x, h0, R))
+        for name, a, b_ in zip(("x", "h0", "R"), gk, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=f"d{name} tiled")
+
+    @pytest.mark.parametrize("H", [100, 200])
+    def test_unaligned_hidden_padding_exact(self, rng, H):
+        from deeplearning4j_tpu.ops.pallas import fused_gru_layer
+        from deeplearning4j_tpu.ops.recurrent import gru_layer
+        B, T, F = 8, 5, 8
+        x, h0, W, R, b = self._mk(rng, B, T, F, H)
+        ok, hk = fused_gru_layer(x, h0, W, R, b)
+        os_, hs = gru_layer(x, h0, W, R, b)
+        np.testing.assert_allclose(np.asarray(ok), np.asarray(os_),
+                                   rtol=2e-5, atol=2e-6)
+        gk = jax.grad(lambda R: fused_gru_layer(x, h0, W, R, b)[0].sum())(R)
+        gs = jax.grad(lambda R: gru_layer(x, h0, W, R, b)[0].sum())(R)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gs),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_scan_fallback_flag(self, rng, monkeypatch):
+        import deeplearning4j_tpu.ops.pallas.fused_gru as fg
+        from deeplearning4j_tpu.common.env import env
+
+        x, h0, W, R, b = self._mk(rng, 8, 4, 8, 128)
+        g_kernel = jax.grad(lambda W: fg.fused_gru_layer(
+            x, h0, W, R, b)[0].sum())(W)
+        monkeypatch.setenv("DL4J_TPU_GRU_SCAN_BWD", "1")
+        env.reload()
+        try:
+            g_scan = jax.grad(lambda W: fg.fused_gru_layer(
+                x, h0, W, R, b)[0].sum())(W)
+        finally:
+            monkeypatch.delenv("DL4J_TPU_GRU_SCAN_BWD")
+            env.reload()
+        np.testing.assert_allclose(np.asarray(g_kernel), np.asarray(g_scan),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_registry_selection(self, rng):
+        """The gru_layer op routes through the kernel in its selected regime
+        (one tile spans H) and stays on the scan for multi-tile shapes."""
+        from deeplearning4j_tpu.ops.pallas.fused_gru import (_gru_applicable,
+                                                             gru_tile)
+
+        x = jnp.zeros((64, 8, 32))
+        h0 = jnp.zeros((64, 256))
+        W = jnp.zeros((32, 768))
+        R = jnp.zeros((256, 768))
+        b = jnp.zeros((768,))
+        assert _gru_applicable(x, h0, W, R, b)
+        # big B*H where even the largest fitting tile < H: not applicable
+        xb = jnp.zeros((256, 8, 32))
+        hb_ = jnp.zeros((256, 2048))
+        Wb = jnp.zeros((32, 6144))
+        Rb = jnp.zeros((2048, 6144))
+        bb = jnp.zeros((6144,))
+        if gru_tile(256, 2048, save_residuals=True) != 2048:
+            assert not _gru_applicable(xb, hb_, Wb, Rb, bb)
+
+    def test_gru_layer_class_reaches_kernel(self, rng, monkeypatch):
+        """End-to-end: the nn GRU layer's op("gru_layer") dispatch selects
+        the Pallas impl for an aligned shape."""
+        import deeplearning4j_tpu.ops.pallas.fused_gru as fg
+
+        called = []
+        orig = fg._fused_gru_recurrence
+
+        def spy(*a, **kw):
+            called.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(fg, "_fused_gru_recurrence", spy)
+        from deeplearning4j_tpu.ops import get_op
+        x = jnp.asarray(rng.normal(size=(8, 4, 16)).astype(np.float32))
+        h0 = jnp.zeros((8, 128))
+        W = jnp.asarray(rng.normal(size=(16, 384)).astype(np.float32) * 0.1)
+        R = jnp.asarray(rng.normal(size=(128, 384)).astype(np.float32) * 0.1)
+        b = jnp.zeros((384,))
+        get_op("gru_layer")(x, h0, W, R, b)
+        assert called, "registry did not route gru_layer to the kernel"

@@ -176,3 +176,74 @@ class TestDuelingAndConv:
         env.reset()
         obs, r, done = env.step(1)
         assert obs.shape == (8, 8)  # two raw steps happened inside
+
+
+class TestFrameStackReplay:
+    def _mk(self, capacity=32, k=3, shape=(4, 4)):
+        from deeplearning4j_tpu.rl import FrameStackReplay
+        return FrameStackReplay(capacity, shape, k, seed=0)
+
+    def _frame(self, v):
+        return np.full((4, 4), float(v), np.float32)
+
+    def _stack(self, *vs):
+        return np.stack([self._frame(v) for v in vs], axis=-1)
+
+    def test_stacks_match_what_was_stored(self):
+        buf = self._mk()
+        # episode: frames 1,2,3,4 (transitions 1->2, 2->3, 3->4 done)
+        buf.store(self._stack(1, 1, 1), 0, 0.1, self._stack(1, 1, 2), False)
+        buf.store(self._stack(1, 1, 2), 1, 0.2, self._stack(1, 2, 3), False)
+        buf.store(self._stack(1, 2, 3), 0, 0.3, self._stack(2, 3, 4), True)
+        assert len(buf) == 3
+        obs, acts, rews, nxt, dones = buf.sample(64)
+        for o, a, r, n, d in zip(obs, acts, rews, nxt, dones):
+            if r == np.float32(0.1):
+                # earliest transition: stack left-pads with episode frame 1
+                assert np.array_equal(o, self._stack(1, 1, 1))
+                assert np.array_equal(n, self._stack(1, 1, 2))
+            elif r == np.float32(0.3):
+                assert np.array_equal(o, self._stack(1, 2, 3))
+                assert np.array_equal(n, self._stack(2, 3, 4))
+                assert d == 1.0
+
+    def test_no_cross_episode_stacks(self):
+        buf = self._mk()
+        buf.store(self._stack(7, 7, 7), 0, 1.0, self._stack(7, 7, 8), True)
+        buf.store(self._stack(9, 9, 9), 1, 2.0, self._stack(9, 9, 10), True)
+        obs, acts, rews, nxt, _ = buf.sample(32)
+        for o, r in zip(obs, rews):
+            # stacks never mix frames from the two episodes
+            vals = set(np.unique(o))
+            assert vals <= {7.0} or vals <= {9.0}
+
+    def test_memory_is_one_frame_per_step(self):
+        buf = self._mk(capacity=100, k=4, shape=(8, 8))
+        # 10 steps -> 10 frame slots + 1 terminal, NOT 10*2*4 stacked copies
+        for t in range(10):
+            buf.store(np.full((8, 8, 4), t, np.float32),
+                      0, 0.0, np.full((8, 8, 4), t + 1, np.float32),
+                      t == 9)
+        assert buf.frames.shape == (100, 8, 8)  # single frames only
+        assert len(buf) == 10
+
+    def test_ring_overwrite_invalidates_cleanly(self):
+        buf = self._mk(capacity=8, k=2)
+        for ep in range(4):                     # 4 episodes x (2+1) slots
+            buf.store(self._stack(ep, ep), 0, float(ep),
+                      self._stack(ep, ep + 10), False)
+            buf.store(self._stack(ep, ep + 10), 1, float(ep) + 0.5,
+                      self._stack(ep + 10, ep + 20), True)
+        obs, acts, rews, nxt, dones = buf.sample(16)
+        assert obs.shape == (16, 4, 4, 2)       # sampling still works
+
+    def test_conv_dqn_uses_frame_ring(self):
+        from deeplearning4j_tpu.rl import (FrameStackReplay, HistoryProcessor,
+                                           PixelGridWorld,
+                                           QLearningDiscreteConv)
+        env = PixelGridWorld(size=8, max_steps=10, seed=0)
+        hp = HistoryProcessor(history_length=2).set_input_shape(8, 8)
+        ql = QLearningDiscreteConv(env, hp, channels=(8,), dense=16,
+                                   min_replay=8, batch_size=8, seed=0)
+        assert isinstance(ql.replay, FrameStackReplay)
+        ql.train(3)  # smoke: stores + samples through the frame ring
