@@ -540,6 +540,8 @@ def bench_kernels(rounds=3, budget_deadline=None):
 
         rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
         if not over_deadline():
+            rows("B32_H1024", 32, 64, 256, 1024, 150)   # selected (R resident)
+        if not over_deadline():
             rows("B256_H1024", 256, 64, 512, 1024, 60)  # demoted (nj>1)
 
     # ---- fused GRU: same regimes as the LSTM (3-gate cell, same policy)
@@ -575,6 +577,8 @@ def bench_kernels(rounds=3, budget_deadline=None):
                 iters=iters, rounds=rounds)
 
         rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
+        if not over_deadline():
+            rows("B64_H1024", 64, 64, 256, 1024, 150)   # selected (R resident)
         if not over_deadline():
             rows("B256_H1024", 256, 64, 512, 1024, 60)  # multi-tile check
 

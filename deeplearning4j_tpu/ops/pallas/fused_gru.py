@@ -96,7 +96,8 @@ def gru_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
     for hb in (H, 1024, 512, 256, 128):
         if hb > H or H % hb:
             continue
-        est = (2 * H * 3 * hb * rdtype_bytes   # R panel (dbl-buffered)
+        r_bufs = 1 if hb == H else 2           # grid-invariant panel: once
+        est = (r_bufs * H * 3 * hb * rdtype_bytes  # R panel
                + 2 * B * 3 * hb * 4            # xg block (dbl-buffered)
                + 2 * 2 * B * hb * 4            # out/hT tiles (dbl)
                + 2 * B * H * 4                 # h double buffer
@@ -112,7 +113,8 @@ def gru_bwd_tile(B, H, rdtype_bytes=2, budget=13 << 20):
     for hb in (H, 1024, 512, 256, 128):
         if hb > H or H % hb:
             continue
-        est = (2 * H * 3 * hb * rdtype_bytes   # R^T panel (dbl-buffered)
+        r_bufs = 1 if hb == H else 2
+        est = (r_bufs * H * 3 * hb * rdtype_bytes  # R^T panel
                + 2 * 6 * B * hb * 4            # r/z/n/hgn/hprev/dout (dbl)
                + 2 * 3 * B * hb * 4            # dgr/dgz/dgn out tiles (dbl)
                + B * H * 4                     # dh0: full-H invariant block

@@ -144,12 +144,16 @@ def lstm_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
     Grid-VARYING blocks (R/xg/peephole panels indexed by t or j, and the
     out/hT/cT[/cseq/gate] tiles) are double-buffered by the Pallas
     pipeline, so they count twice; the grid-invariant h0/c0 blocks and the
-    three scratch buffers count once. R panels are bf16 on TPU
+    three scratch buffers count once. When ONE tile spans H the R panel's
+    block index is grid-constant, so it is fetched once and counts ONCE —
+    that accounting unlocks full-residency at H=1024/small-B, measured
+    1.2-1.5x the scan on-chip (BASELINE.md r3). R panels are bf16 on TPU
     (rdtype_bytes=2). Budget is set under the ~16M scoped-VMEM limit."""
     for hb in (H, 1024, 512, 256, 128):
         if hb > H or H % hb:
             continue
-        est = (2 * H * 4 * hb * rdtype_bytes   # R panel (dbl-buffered)
+        r_bufs = 1 if hb == H else 2           # grid-invariant panel: once
+        est = (r_bufs * H * 4 * hb * rdtype_bytes  # R panel
                + 2 * B * 4 * hb * 4            # xg block (dbl-buffered)
                + 2 * 3 * B * hb * 4            # out/hT/cT tiles (dbl)
                + 3 * B * H * 4                 # h double buffer + c scratch
@@ -164,11 +168,13 @@ def lstm_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
 def lstm_bwd_tile(B, H, rdtype_bytes=2, budget=13 << 20):
     """Tile selector for the backward kernel. Its working set is smaller
     than the forward's: no xg / h_prev inputs (gates come from the saved
-    reserve), one transposed R panel (read only for dg_j @ R_j^T)."""
+    reserve), one transposed R panel (read only for dg_j @ R_j^T; counted
+    once when grid-invariant, i.e. hb == H)."""
     for hb in (H, 1024, 512, 256, 128):
         if hb > H or H % hb:
             continue
-        est = (2 * H * 4 * hb * rdtype_bytes   # R^T panel (dbl-buffered)
+        r_bufs = 1 if hb == H else 2
+        est = (r_bufs * H * 4 * hb * rdtype_bytes  # R^T panel
                + 2 * 4 * B * hb * 4            # gate tiles (dbl)
                + 3 * 2 * B * hb * 4            # c_prev/c/dout tiles (dbl)
                + 2 * 4 * B * hb * 4            # dg out tiles (dbl)

@@ -154,8 +154,13 @@ class _QLearningDiscrete:
         self.opt = {"step": jnp.asarray(0),
                     "state": self._updater.init_state(self.params)}
         replay = self._make_buffer(replay_capacity, obs_shape, seed)
-        self.replay = (replay if n_step == 1
-                       else NStepAccumulator(replay, n_step, gamma))
+        if n_step == 1 or getattr(replay, "handles_n_step", False):
+            # frame-ring buffers own their n-step window (an accumulator in
+            # front would pair pre-summed rewards with the WRONG ring
+            # successor) — see FrameStackReplay
+            self.replay = replay
+        else:
+            self.replay = NStepAccumulator(replay, n_step, gamma)
         self.step_count = 0
         self.episode_rewards: List[float] = []
         self._q_fn = jax.jit(apply)
@@ -306,9 +311,11 @@ class QLearningDiscreteConv(_QLearningDiscrete):
 
     def _make_buffer(self, capacity, obs_shape, seed):
         # frame-ring store: one copy per raw frame instead of 2*history
-        # stacked copies per transition (the DQN-Nature replay layout)
+        # stacked copies per transition (the DQN-Nature replay layout);
+        # n-step windows are computed inside the ring at sample time
         from deeplearning4j_tpu.rl.replay import FrameStackReplay
-        return FrameStackReplay(capacity, obs_shape[:-1], obs_shape[-1], seed)
+        return FrameStackReplay(capacity, obs_shape[:-1], obs_shape[-1], seed,
+                                n_step=self.n_step, gamma=self.gamma)
 
     def _observe(self, obs: np.ndarray) -> np.ndarray:
         return self.history.observe(obs)

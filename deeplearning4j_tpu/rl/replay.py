@@ -60,14 +60,32 @@ class FrameStackReplay:
     newest frame from each stack internally; ``sample`` returns stacked
     [B, H, W, k] observations identical to what was stored.
 
+    n-step returns are computed AT SAMPLE TIME from the stored per-step
+    rewards (pass ``n_step``/``gamma``) rather than via NStepAccumulator —
+    an accumulator in front of a frame ring would store obs_t's frame but
+    pair it with a pre-summed reward whose true successor is s_{t+n}, while
+    the ring's adjacency reconstructs s_{t+1}: silently wrong targets. The
+    trainer still bootstraps with gamma**n_step; episode ends shorten the
+    window (done inside the window => no bootstrap, same as the reference's
+    episode-boundary flush).
+
     ``frame_dtype``: np.float32 default; pass np.uint8 for byte-valued
     frames (ALE-style) to cut memory another 4x.
     """
 
+    #: n-step semantics live inside this buffer; the trainer must NOT wrap
+    #: it in an NStepAccumulator
+    handles_n_step = True
+
     def __init__(self, capacity, frame_shape, history_length: int,
-                 seed: int = 0, frame_dtype=np.float32):
+                 seed: int = 0, frame_dtype=np.float32, n_step: int = 1,
+                 gamma: float = 0.99):
+        if n_step < 1:
+            raise ValueError("n_step must be >= 1")
         self.capacity = capacity
         self.k = history_length
+        self.n_step = n_step
+        self.gamma = gamma
         self._rng = np.random.default_rng(seed)
         self.frames = np.zeros((capacity, *frame_shape), frame_dtype)
         self.actions = np.zeros(capacity, np.int32)
@@ -135,14 +153,42 @@ class FrameStackReplay:
         return np.stack([self.frames[j].astype(np.float32) for j in idxs],
                         axis=-1)
 
-    def _valid(self, i):
-        if not self.has_transition[i]:
-            return False
-        nxt = (i + 1) % self.capacity
-        # the successor slot must still be this episode's next step (it may
-        # have been overwritten by the ring, or not written yet)
-        return (self.ep[nxt] == self.ep[i]
-                and self.t_in_ep[nxt] == self.t_in_ep[i] + 1)
+    def _succ_ok(self, i, j):
+        """Slot (i+j) % capacity still holds this episode's step t_i + j."""
+        s = (i + j) % self.capacity
+        return (self.ep[s] == self.ep[i]
+                and self.t_in_ep[s] == self.t_in_ep[i] + j)
+
+    def _history_ok(self, i):
+        """The frames the obs stack at slot i needs must have SURVIVED the
+        ring: walk back min(k-1, t_in_ep) steps requiring the consecutive
+        same-episode chain (repeat-padding is only legitimate at episode
+        starts, where the missing history never existed)."""
+        back = min(self.k - 1, int(self.t_in_ep[i]))
+        cur = i
+        for _ in range(back):
+            prev = (cur - 1) % self.capacity
+            if not (self.ep[prev] == self.ep[cur]
+                    and self.t_in_ep[prev] == self.t_in_ep[cur] - 1):
+                return False
+            cur = prev
+        return True
+
+    def _window(self, i):
+        """n-step window starting at transition slot i: returns
+        (G, next_slot, done) or None if any needed slot was overwritten.
+        The window shortens at episode end (done inside => no bootstrap)."""
+        g = 0.0
+        for j in range(self.n_step):
+            s = (i + j) % self.capacity
+            if not (self._succ_ok(i, j) and self.has_transition[s]):
+                return None
+            g += (self.gamma ** j) * float(self.rewards[s])
+            if self.dones[s]:
+                nxt = (i + j + 1) % self.capacity
+                return (g, nxt, 1.0) if self._succ_ok(i, j + 1) else None
+        nxt = (i + self.n_step) % self.capacity
+        return (g, nxt, 0.0) if self._succ_ok(i, self.n_step) else None
 
     def sample(self, batch_size: int) -> Tuple[np.ndarray, ...]:
         obs, actions, rewards, next_obs, dones = [], [], [], [], []
@@ -153,13 +199,17 @@ class FrameStackReplay:
             if tries > 200 * batch_size:
                 raise RuntimeError("FrameStackReplay: not enough valid "
                                    "transitions to sample from")
-            if not self._valid(i):
+            if not (self.has_transition[i] and self._history_ok(i)):
                 continue
+            win = self._window(i)
+            if win is None or not self._history_ok(win[1]):
+                continue
+            g, nxt, done = win
             obs.append(self._stack_ending_at(i))
-            next_obs.append(self._stack_ending_at((i + 1) % self.capacity))
+            next_obs.append(self._stack_ending_at(nxt))
             actions.append(self.actions[i])
-            rewards.append(self.rewards[i])
-            dones.append(self.dones[i])
+            rewards.append(g)
+            dones.append(done)
         return (np.stack(obs), np.asarray(actions, np.int32),
                 np.asarray(rewards, np.float32), np.stack(next_obs),
                 np.asarray(dones, np.float32))
