@@ -19,9 +19,13 @@ from deeplearning4j_tpu.rl.history import (HistoryConfiguration,
                                            HistoryProcessor)
 from deeplearning4j_tpu.rl.dqn import (QLearningDiscreteConv,
                                        QLearningDiscreteDense)
-from deeplearning4j_tpu.rl.actor_critic import A2CDiscreteDense
+from deeplearning4j_tpu.rl.actor_critic import (A2CDiscreteDense,
+                                                A3CDiscrete,
+                                                A3CDiscreteConv,
+                                                A3CDiscreteDense)
 
 __all__ = ["MDP", "CartPole", "PixelGridWorld", "FrameSkipWrapper",
            "ExpReplay", "FrameStackReplay", "NStepAccumulator", "HistoryProcessor",
            "HistoryConfiguration", "QLearningDiscreteDense",
-           "QLearningDiscreteConv", "A2CDiscreteDense"]
+           "QLearningDiscreteConv", "A2CDiscreteDense",
+           "A3CDiscrete", "A3CDiscreteDense", "A3CDiscreteConv"]

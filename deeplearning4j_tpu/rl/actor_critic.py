@@ -21,25 +21,239 @@ from deeplearning4j_tpu.rl.dqn import _mlp_apply, _mlp_init
 from deeplearning4j_tpu.rl.env import MDP
 
 
+def _ac_loss(logits, values, actions, returns, value_coef, entropy_coef,
+             normalize_adv=False):
+    """Combined policy + value + entropy loss (shared by the A2C and A3C
+    paths). ``normalize_adv`` standardizes only the ADVANTAGE — the value
+    head always regresses the raw returns, so its output stays on the
+    absolute scale the A3C bootstrap feeds back in."""
+    adv = returns - jax.lax.stop_gradient(values)
+    if normalize_adv:
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    logp = jax.nn.log_softmax(logits)
+    chosen = jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
+    policy_loss = -(chosen * adv).mean()
+    value_loss = ((values - returns) ** 2).mean()
+    entropy = -(jnp.exp(logp) * logp).sum(-1).mean()
+    return policy_loss + value_coef * value_loss - entropy_coef * entropy
+
+
 @functools.partial(jax.jit, donate_argnums=(0,),
                    static_argnames=("lr", "value_coef", "entropy_coef"))
 def _a2c_step(params, obs, actions, returns, lr, value_coef, entropy_coef):
     def loss_fn(p):
-        trunk_out = _mlp_apply(p["trunk"], obs)
-        h = jax.nn.relu(trunk_out)
+        h = jax.nn.relu(_mlp_apply(p["trunk"], obs))
         logits = h @ p["pi"]["W"] + p["pi"]["b"]
         values = (h @ p["v"]["W"] + p["v"]["b"])[:, 0]
-        adv = returns - jax.lax.stop_gradient(values)
-        logp = jax.nn.log_softmax(logits)
-        chosen = jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
-        policy_loss = -(chosen * adv).mean()
-        value_loss = ((values - returns) ** 2).mean()
-        entropy = -(jnp.exp(logp) * logp).sum(-1).mean()
-        return policy_loss + value_coef * value_loss - entropy_coef * entropy
+        return _ac_loss(logits, values, actions, returns, value_coef,
+                        entropy_coef)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
     params = jax.tree_util.tree_map(lambda x, g: x - lr * g, params, grads)
     return params, loss
+
+
+class A3CDiscrete:
+    """The A3C analog: N environment copies advanced in lockstep with ONE
+    batched jitted policy evaluation per step, t_max-segment rollouts with
+    V(s_T) bootstrap for unfinished episodes, and a single combined
+    policy+value+entropy update per segment.
+
+    Reference analog: org.deeplearning4j.rl4j.learning.async.a3c.discrete.
+    A3CDiscrete{Dense,Conv} — there, N async worker THREADS each own an env
+    and race updates into a shared net; here the workers collapse into a
+    batch dimension (the async machinery was a JVM throughput device, not
+    an algorithmic requirement — synchronous batched A2C is the same
+    estimator with strictly lower gradient staleness).
+
+    ``env_factory(i) -> MDP`` builds the i-th environment copy (seeded
+    differently per i). ``trunk``: (init, apply->hidden) pair; use
+    ``a3c_dense_trunk`` / dqn's ``_conv_trunk``.
+    """
+
+    def __init__(self, env_factory, n_envs: int, trunk, hidden_size: int,
+                 n_actions: int, observe=None, gamma: float = 0.99,
+                 lr: float = 7e-3, value_coef: float = 0.5,
+                 entropy_coef: float = 0.01, t_max: int = 20, seed: int = 0):
+        self._env_factory = env_factory
+        self.envs = [env_factory(i) for i in range(n_envs)]
+        self.n_actions = n_actions
+        self.gamma = gamma
+        self.lr = lr
+        self.value_coef = value_coef
+        self.entropy_coef = entropy_coef
+        self.t_max = t_max
+        self._observe = observe or (lambda i, raw: raw)
+        self._rng = np.random.default_rng(seed)
+        trunk_init, trunk_apply = trunk
+        key = jax.random.key(seed)
+        k1, k2 = jax.random.split(jax.random.fold_in(key, 99))
+        self.params = {
+            "trunk": trunk_init(key),
+            "pi": {"W": jax.random.normal(k1, (hidden_size, n_actions)) * 0.01,
+                   "b": jnp.zeros(n_actions)},
+            "v": {"W": jax.random.normal(k2, (hidden_size, 1)) * 0.01,
+                  "b": jnp.zeros(1)},
+        }
+        self._trunk_apply = trunk_apply
+
+        def heads(p, x):
+            h = trunk_apply(p["trunk"], x)
+            logits = h @ p["pi"]["W"] + p["pi"]["b"]
+            values = (h @ p["v"]["W"] + p["v"]["b"])[:, 0]
+            return logits, values
+
+        self._heads = jax.jit(heads)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def update(p, obs, actions, returns):
+            def loss_fn(p):
+                logits, values = heads(p, obs)
+                return _ac_loss(logits, values, actions, returns,
+                                value_coef, entropy_coef, normalize_adv=True)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            return jax.tree_util.tree_map(lambda a, g: a - lr * g, p,
+                                          grads), loss
+
+        self._update = update
+        self._obs = [self._observe(i, e.reset()) for i, e in
+                     enumerate(self.envs)]
+        self._ep_rew = [0.0] * n_envs
+        self.episode_rewards: List[float] = []
+
+    def act_batch(self, obs_batch, greedy: bool = False) -> np.ndarray:
+        logits, _ = self._heads(self.params, jnp.asarray(obs_batch))
+        logits = np.asarray(logits)
+        if greedy:
+            return logits.argmax(axis=1)
+        z = np.exp(logits - logits.max(axis=1, keepdims=True))
+        probs = z / z.sum(axis=1, keepdims=True)
+        return np.array([self._rng.choice(self.n_actions, p=pr)
+                         for pr in probs])
+
+    def train_segment(self) -> float:
+        """One t_max segment across all envs -> one update (the A3C inner
+        loop, synchronous)."""
+        n = len(self.envs)
+        obs_l = np.zeros((self.t_max, n, *np.shape(self._obs[0])), np.float32)
+        act_l = np.zeros((self.t_max, n), np.int32)
+        rew_l = np.zeros((self.t_max, n), np.float32)
+        done_l = np.zeros((self.t_max, n), np.float32)
+        for t in range(self.t_max):
+            batch = np.stack(self._obs)
+            actions = self.act_batch(batch)
+            obs_l[t] = batch
+            act_l[t] = actions
+            for i, e in enumerate(self.envs):
+                raw, r, done = e.step(int(actions[i]))
+                rew_l[t, i] = r
+                done_l[t, i] = float(done)
+                self._ep_rew[i] += r
+                if done:
+                    self.episode_rewards.append(self._ep_rew[i])
+                    self._ep_rew[i] = 0.0
+                    raw = e.reset()
+                self._obs[i] = self._observe(i, raw)
+        # bootstrap unfinished episodes with V(s_T)
+        _, v_last = self._heads(self.params, jnp.asarray(np.stack(self._obs)))
+        g = np.asarray(v_last)
+        returns = np.zeros_like(rew_l)
+        for t in range(self.t_max - 1, -1, -1):
+            g = rew_l[t] + self.gamma * (1.0 - done_l[t]) * g
+            returns[t] = g
+        flat = lambda a: a.reshape(self.t_max * n, *a.shape[2:])
+        self.params, loss = self._update(self.params, jnp.asarray(flat(obs_l)),
+                                         jnp.asarray(flat(act_l)),
+                                         jnp.asarray(flat(returns)))
+        return float(loss)
+
+    def train(self, n_segments: int) -> List[float]:
+        for _ in range(n_segments):
+            self.train_segment()
+        return self.episode_rewards
+
+    def play_episode(self, env=None, observe=None) -> float:
+        """Greedy rollout on a DEDICATED eval env (factory index n_envs) —
+        never a training env, whose (observation, frame-stack) state must
+        stay synchronized with the training loop."""
+        if env is None:
+            idx = len(self.envs)
+            env = self._env_factory(idx)
+            observe = observe or (lambda raw: self._observe(idx, raw))
+        else:
+            observe = observe or (lambda raw: raw)
+        obs = observe(env.reset())
+        total, done = 0.0, False
+        while not done:
+            a = int(self.act_batch(obs[None], greedy=True)[0])
+            raw, r, done = env.step(a)
+            obs = observe(raw)
+            total += r
+        return total
+
+
+def a3c_dense_trunk(obs_size: int, hidden):
+    """(init, apply->hidden) dense trunk for A3CDiscrete."""
+    sizes = [obs_size, *hidden]
+
+    def init(key):
+        return _mlp_init(key, sizes)
+
+    def apply(p, x):
+        return jax.nn.relu(_mlp_apply(p, x))
+
+    return init, apply
+
+
+class A3CDiscreteDense(A3CDiscrete):
+    """A3CDiscreteDense analog: vector observations, dense trunk."""
+
+    def __init__(self, env_factory, n_envs: int = 8, hidden=(64,),
+                 **kwargs):
+        probe = env_factory(0)
+        super().__init__(env_factory, n_envs,
+                         a3c_dense_trunk(probe.observation_size, hidden),
+                         hidden[-1], probe.n_actions, **kwargs)
+
+
+class A3CDiscreteConv(A3CDiscrete):
+    """A3CDiscreteConv analog: pixel observations through per-env
+    HistoryProcessors and the shared conv trunk."""
+
+    def __init__(self, env_factory, history_factory, n_envs: int = 4,
+                 channels=(16, 32), dense: int = 128, **kwargs):
+        from deeplearning4j_tpu.rl.dqn import _conv_trunk
+
+        self._hists = {}
+
+        def hist_for(i):
+            if i not in self._hists:
+                self._hists[i] = history_factory(i)
+            return self._hists[i]
+
+        probe = env_factory(0)
+        obs_shape = hist_for(0).output_shape
+
+        def observe(i, raw):
+            return hist_for(i).observe(raw)
+
+        # wrap env.reset so the frame stack clears whenever its env resets
+        def factory(i):
+            env = env_factory(i)
+            orig_reset = env.reset
+            hist = hist_for(i)
+
+            def reset():
+                hist.reset()
+                return orig_reset()
+
+            env.reset = reset
+            return env
+
+        super().__init__(factory, n_envs, _conv_trunk(obs_shape, channels,
+                                                      dense),
+                         dense, probe.n_actions, observe=observe, **kwargs)
 
 
 class A2CDiscreteDense:

@@ -59,12 +59,13 @@ def _dense_net(obs_size: int, hidden: Sequence[int], n_actions: int,
     return init, apply
 
 
-def _conv_net(obs_shape: Tuple[int, int, int], channels: Sequence[int],
-              dense: int, n_actions: int, dueling: bool):
-    """(init, apply) for the pixel Q-net: 3x3 stride-2 conv stack (NHWC)
-    -> flatten -> dense -> Q heads. The reference's conv topology is the
-    DQN-Nature stack; strided 3x3s keep the same receptive-field growth
-    while staying friendly to small test frames."""
+def _conv_trunk(obs_shape: Tuple[int, int, int], channels: Sequence[int],
+                dense: int):
+    """(init, apply) for a pixel trunk: 3x3 stride-2 conv stack (NHWC)
+    -> flatten -> dense -> hidden vector. The reference's conv topology is
+    the DQN-Nature stack; strided 3x3s keep the same receptive-field
+    growth while staying friendly to small test frames. Shared by the
+    conv DQN and the A3C-analog actor-critic."""
 
     def init(key):
         params = {"conv": []}
@@ -84,8 +85,6 @@ def _conv_net(obs_shape: Tuple[int, int, int], channels: Sequence[int],
         params["dense"] = {"W": jax.random.normal(kd, (flat, dense))
                            * jnp.sqrt(2.0 / flat),
                            "b": jnp.zeros(dense)}
-        params.update(_dueling_heads_init(jax.random.fold_in(key, 1000),
-                                          dense, n_actions, dueling))
         return params
 
     def apply(p, x):
@@ -95,8 +94,24 @@ def _conv_net(obs_shape: Tuple[int, int, int], channels: Sequence[int],
                 dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
             x = jax.nn.relu(x)
         x = x.reshape(x.shape[0], -1)
-        h = jax.nn.relu(x @ p["dense"]["W"] + p["dense"]["b"])
-        return _dueling_heads_apply(p, h, dueling)
+        return jax.nn.relu(x @ p["dense"]["W"] + p["dense"]["b"])
+
+    return init, apply
+
+
+def _conv_net(obs_shape: Tuple[int, int, int], channels: Sequence[int],
+              dense: int, n_actions: int, dueling: bool):
+    """(init, apply) for the pixel Q-net: conv trunk -> Q heads."""
+    trunk_init, trunk_apply = _conv_trunk(obs_shape, channels, dense)
+
+    def init(key):
+        params = trunk_init(key)
+        params.update(_dueling_heads_init(jax.random.fold_in(key, 1000),
+                                          dense, n_actions, dueling))
+        return params
+
+    def apply(p, x):
+        return _dueling_heads_apply(p, trunk_apply(p, x), dueling)
 
     return init, apply
 

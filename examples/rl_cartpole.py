@@ -1,0 +1,36 @@
+"""Reinforcement learning on CartPole — DQN and batched-env A3C.
+
+Reference analogs: rl4j-examples Cartpole (QLearningDiscreteDense) and the
+A3CDiscreteDense examples. TPU-first: the whole DQN update is one jitted
+donated XLA program; the A3C "async workers" are a batch dimension —
+N environments advance in lockstep under ONE policy evaluation per step.
+"""
+
+from deeplearning4j_tpu.rl import (A3CDiscreteDense, CartPole,
+                                   QLearningDiscreteDense)
+
+
+def main(episodes: int = 200, segments: int = 80, dueling: bool = True,
+         n_step: int = 3):
+    # ---- DQN (double + dueling + n-step, the full QLConfiguration surface)
+    dqn = QLearningDiscreteDense(
+        CartPole(seed=1, max_steps=200), hidden=[64], lr=1e-3,
+        min_replay=300, target_update_freq=200, eps_decay_steps=4000,
+        double_dqn=True, dueling=dueling, n_step=n_step, seed=3)
+    rewards = dqn.train(episodes)
+    dqn_score = dqn.play_episode()
+    print(f"DQN: first-20 avg {sum(rewards[:20]) / 20:.1f} -> "
+          f"last-20 avg {sum(rewards[-20:]) / 20:.1f}; greedy {dqn_score:.0f}")
+
+    # ---- A3C analog: 8 envs, t_max segments, bootstrapped returns
+    a3c = A3CDiscreteDense(lambda i: CartPole(seed=100 + i, max_steps=200),
+                           n_envs=8, hidden=(64,), lr=0.01, t_max=32, seed=5)
+    a3c.train(segments)
+    a3c_score = a3c.play_episode()
+    print(f"A3C: {len(a3c.episode_rewards)} episodes across 8 envs; "
+          f"greedy {a3c_score:.0f}")
+    return dqn_score, a3c_score
+
+
+if __name__ == "__main__":
+    main()

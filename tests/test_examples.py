@@ -67,3 +67,17 @@ def test_long_context_zigzag():
 
     losses = long_context_zigzag.main(T=128, d_model=128, n_heads=1, steps=3)
     assert losses[-1] < losses[0]
+
+
+def test_rl_cartpole():
+    from examples import rl_cartpole
+
+    dqn_score, a3c_score = rl_cartpole.main(episodes=30, segments=10)
+    assert dqn_score > 0 and a3c_score > 0
+
+
+def test_datavec_etl():
+    from examples import datavec_etl
+
+    acc = datavec_etl.main(epochs=20, n=240)
+    assert acc > 0.85

@@ -309,3 +309,50 @@ class TestFrameStackReplayReviewRepros:
         assert isinstance(ql.replay, FrameStackReplay)
         assert ql.replay.n_step == 3
         ql.train(4)
+
+
+class TestA3CBatchedEnvs:
+    def test_dense_learns_cartpole(self):
+        from deeplearning4j_tpu.rl import A3CDiscreteDense, CartPole
+        a3c = A3CDiscreteDense(lambda i: CartPole(seed=100 + i, max_steps=200),
+                               n_envs=8, hidden=(64,), lr=0.01, t_max=32,
+                               seed=5)
+        a3c.train(120)
+        # batched-env policy beats the ~20-step random baseline clearly
+        assert a3c.play_episode() > 60
+
+    def test_segments_bootstrap_unfinished(self):
+        from deeplearning4j_tpu.rl import A3CDiscreteDense, CartPole
+        a3c = A3CDiscreteDense(lambda i: CartPole(seed=i), n_envs=4,
+                               t_max=5, seed=0)
+        loss = a3c.train_segment()   # shorter than any episode: pure bootstrap
+        assert np.isfinite(loss)
+        assert len(a3c.episode_rewards) == 0  # nothing finished in 5 steps
+
+    def test_conv_pixel_smoke_and_learn(self):
+        from deeplearning4j_tpu.rl import (A3CDiscreteConv, HistoryProcessor,
+                                           PixelGridWorld)
+        a3c = A3CDiscreteConv(
+            lambda i: PixelGridWorld(size=8, max_steps=25, seed=50 + i),
+            lambda i: HistoryProcessor(history_length=2).set_input_shape(8, 8),
+            n_envs=4, channels=(8,), dense=32, lr=5e-3, t_max=25, seed=1)
+        a3c.train(80)
+        wins = sum(a3c.play_episode() > 0.5 for _ in range(5))
+        assert wins >= 3, wins
+
+    def test_play_episode_does_not_desync_training(self):
+        # review repro: play between train calls must not touch training
+        # envs or their frame stacks
+        from deeplearning4j_tpu.rl import A3CDiscreteDense, CartPole
+        a3c = A3CDiscreteDense(lambda i: CartPole(seed=i), n_envs=3,
+                               t_max=4, seed=0)
+        a3c.train_segment()
+        obs_before = [o.copy() for o in a3c._obs]
+        n_eps = len(a3c.episode_rewards)
+        a3c.play_episode()
+        # training observations untouched by the eval rollout
+        for a, b in zip(obs_before, a3c._obs):
+            assert np.array_equal(a, b)
+        assert len(a3c.episode_rewards) == n_eps
+        loss = a3c.train_segment()          # still trains cleanly
+        assert np.isfinite(loss)
