@@ -505,7 +505,7 @@ def bench_kernels(rounds=3, budget_deadline=None):
             iters=250, rounds=rounds)
 
     # ---- fused LSTM: selected regime (nj==1) and demoted multi-tile regime
-    def lstm_rows():
+    def _lstm_rowfn():
         from deeplearning4j_tpu.ops.pallas.fused_lstm import fused_lstm_layer
         from deeplearning4j_tpu.ops.recurrent import lstm_layer
 
@@ -538,14 +538,19 @@ def bench_kernels(rounds=3, budget_deadline=None):
                 lambda: train(fused_lstm_layer), lambda: train(lstm_layer),
                 iters=iters, rounds=rounds)
 
+        return rows
+
+    def lstm_rows():
+        rows = _lstm_rowfn()
         rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
         if not over_deadline():
             rows("B32_H1024", 32, 64, 256, 1024, 150)   # selected (R resident)
-        if not over_deadline():
-            rows("B256_H1024", 256, 64, 512, 1024, 60)  # demoted (nj>1)
+
+    def lstm_demoted_rows():
+        _lstm_rowfn()("B256_H1024", 256, 64, 512, 1024, 60)  # demoted (nj>1)
 
     # ---- fused GRU: same regimes as the LSTM (3-gate cell, same policy)
-    def gru_rows():
+    def _gru_rowfn():
         from deeplearning4j_tpu.ops.pallas.fused_gru import fused_gru_layer
         from deeplearning4j_tpu.ops.recurrent import gru_layer
 
@@ -576,11 +581,16 @@ def bench_kernels(rounds=3, budget_deadline=None):
                 lambda: train(fused_gru_layer), lambda: train(gru_layer),
                 iters=iters, rounds=rounds)
 
+        return rows
+
+    def gru_rows():
+        rows = _gru_rowfn()
         rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
         if not over_deadline():
             rows("B64_H1024", 64, 64, 256, 1024, 150)   # selected (R resident)
-        if not over_deadline():
-            rows("B256_H1024", 256, 64, 512, 1024, 60)  # multi-tile check
+
+    def gru_demoted_rows():
+        _gru_rowfn()("B256_H1024", 256, 64, 512, 1024, 60)  # multi-tile check
 
     # ---- LRN, AlexNet conv2 shape. The impl fns are captured at BUILD
     # time (pallas_lrn directly vs the registered xla lowering) — selecting
@@ -615,7 +625,8 @@ def bench_kernels(rounds=3, budget_deadline=None):
             build_train(pallas_lrn), build_train(xla_lrn), iters=400,
             rounds=rounds)
 
-    for block in (flash_rows, lstm_rows, gru_rows, lrn_rows):
+    for block in (flash_rows, lstm_rows, gru_rows, lrn_rows,
+                  lstm_demoted_rows, gru_demoted_rows):
         if over_deadline():
             table["truncated"] = "deadline reached; remaining kernels skipped"
             break

@@ -212,7 +212,9 @@ class A3CDiscreteDense(A3CDiscrete):
     def __init__(self, env_factory, n_envs: int = 8, hidden=(64,),
                  **kwargs):
         probe = env_factory(0)
-        super().__init__(env_factory, n_envs,
+        # reuse the probe as env 0 (don't construct index 0 twice)
+        factory = lambda i: probe if i == 0 else env_factory(i)
+        super().__init__(factory, n_envs,
                          a3c_dense_trunk(probe.observation_size, hidden),
                          hidden[-1], probe.n_actions, **kwargs)
 
@@ -238,9 +240,10 @@ class A3CDiscreteConv(A3CDiscrete):
         def observe(i, raw):
             return hist_for(i).observe(raw)
 
-        # wrap env.reset so the frame stack clears whenever its env resets
+        # wrap env.reset so the frame stack clears whenever its env resets;
+        # env 0 reuses the probe (not constructed twice)
         def factory(i):
-            env = env_factory(i)
+            env = probe if i == 0 else env_factory(i)
             orig_reset = env.reset
             hist = hist_for(i)
 

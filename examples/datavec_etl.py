@@ -55,7 +55,8 @@ def main(epochs: int = 25, n: int = 300):
     print(analyze(tp.final_schema(), records))
     means = (Reducer.builder("label").mean_columns("x", "y").build()
              .reduce(tp.final_schema(), records))
-    print("per-class means:", [[m[0], round(m[1], 2), round(m[2], 2)]
+    # reducer output preserves schema column order: (mean(x), mean(y), label)
+    print("per-class means:", [[m[2], round(m[0], 2), round(m[1], 2)]
                                for m in means])
 
     it = RecordReaderDataSetIterator(CollectionRecordReader(records),
