@@ -19,6 +19,54 @@ import numpy as np
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 
 
+
+def _serve_json(host, port, post_routes, get_routes):
+    """Shared JSON-over-HTTP scaffolding for the serving endpoints: routes
+    are {path: fn(body-dict) -> payload-dict}; errors become JSON 400s.
+    Returns (httpd, thread) — call httpd.shutdown()/server_close() to stop.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code, payload):
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _route(self, routes, body):
+            path = self.path.split("?")[0]
+            fn = routes.get(path)
+            if fn is None:
+                self._reply(404, {"error": "unknown endpoint"})
+                return
+            try:
+                self._reply(200, fn(body))
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                self._reply(400, {"error": str(e)})
+
+        def do_POST(self):  # noqa: N802
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except Exception as e:  # noqa: BLE001
+                self._reply(400, {"error": str(e)})
+                return
+            self._route(post_routes, body)
+
+        def do_GET(self):  # noqa: N802
+            self._route(get_routes, {})
+
+        def log_message(self, *args):
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread
+
+
 class ModelServer:
     """Serve a model's output() via JSON HTTP.
 
@@ -44,43 +92,16 @@ class ModelServer:
         self._pi.start()
         pi, timeout = self._pi, self._timeout
 
-        class Handler(BaseHTTPRequestHandler):
-            def _reply(self, code, payload):
-                data = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+        def predict(body):
+            xs = np.asarray(body["inputs"], np.float32)
+            queues = [pi.submit(x) for x in xs]
+            return {"outputs": [np.asarray(q.get(timeout=timeout)).tolist()
+                                for q in queues]}
 
-            def do_POST(self):  # noqa: N802
-                if self.path.split("?")[0] != "/predict":
-                    self._reply(404, {"error": "unknown endpoint"})
-                    return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                    xs = np.asarray(body["inputs"], np.float32)
-                    queues = [pi.submit(x) for x in xs]
-                    outs = [np.asarray(q.get(timeout=timeout)).tolist()
-                            for q in queues]
-                    self._reply(200, {"outputs": outs})
-                except Exception as e:  # noqa: BLE001 — serving boundary
-                    self._reply(400, {"error": str(e)})
-
-            def do_GET(self):  # noqa: N802
-                if self.path.split("?")[0] == "/health":
-                    self._reply(200, {"status": "ok"})
-                else:
-                    self._reply(404, {"error": "unknown endpoint"})
-
-            def log_message(self, *args):
-                pass
-
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
+        self._httpd, self._thread = _serve_json(
+            self._host, self._port,
+            post_routes={"/predict": predict},
+            get_routes={"/health": lambda _: {"status": "ok"}})
         return self
 
     def stop(self):
@@ -89,3 +110,76 @@ class ModelServer:
             self._httpd.server_close()
             self._httpd = None
         self._pi.stop()
+
+
+class KNNServer:
+    """Nearest-neighbors HTTP server.
+
+    Reference analog: deeplearning4j-nearestneighbors-server's NearestNeighborsServer —
+    a VPTree over an indexed point set behind REST. Endpoints:
+
+        POST /knn     {"point": [...], "k": n}
+                      -> {"results": [{"index": i, "distance": d}, ...]}
+        POST /knnvec  {"vectors": [[...], ...], "k": n}   (batched; brute
+                      MXU path — one device matmul for the whole batch)
+                      -> {"results": [[{"index", "distance"}, ...], ...]}
+        GET  /health
+
+    ``backend``: "vptree" (default, the reference's structure) | "kdtree" |
+    "brute" (single points also answered by the batched MXU path).
+    """
+
+    def __init__(self, points, port: int = 0, host: str = "127.0.0.1",
+                 backend: str = "vptree"):
+        from deeplearning4j_tpu.neighbors import KDTree, VPTree, knn_search
+
+        self.points = np.asarray(points, np.float32)
+        self._host, self._port = host, port
+        self._brute = lambda qs, k: knn_search(self.points, qs, k=k)
+        if backend == "vptree":
+            self._tree = VPTree(self.points)
+        elif backend == "kdtree":
+            self._tree = KDTree(self.points)
+        elif backend == "brute":
+            self._tree = None
+        else:
+            raise ValueError("backend must be vptree|kdtree|brute")
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def _query_one(self, point, k):
+        if self._tree is not None:
+            idx, dist = self._tree.knn(np.asarray(point, np.float32), k=k)
+            return [{"index": int(i), "distance": float(d)}
+                    for i, d in zip(idx, dist)]
+        return self._query_batch([point], k)[0]
+
+    def _query_batch(self, vectors, k):
+        idx, dist = self._brute(np.asarray(vectors, np.float32), k)
+        idx, dist = np.asarray(idx), np.asarray(dist)
+        return [[{"index": int(i), "distance": float(d)}
+                 for i, d in zip(row_i, row_d)]
+                for row_i, row_d in zip(idx, dist)]
+
+    def start(self) -> "KNNServer":
+        self._httpd, self._thread = _serve_json(
+            self._host, self._port,
+            post_routes={
+                "/knn": lambda b: {"results": self._query_one(
+                    b["point"], int(b.get("k", 1)))},
+                "/knnvec": lambda b: {"results": self._query_batch(
+                    b["vectors"], int(b.get("k", 1)))},
+            },
+            get_routes={"/health": lambda _: {"status": "ok",
+                                              "points": len(self.points)}})
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -92,3 +92,69 @@ class TestDeepWalk:
         sim_in = dw.similarity(1, 2)
         sim_out = dw.similarity(1, 7)
         assert sim_in > sim_out
+
+
+class TestKNNServer:
+    def test_endpoints_match_direct_search(self):
+        import json
+        import urllib.request
+
+        import numpy as np
+
+        from deeplearning4j_tpu.neighbors import knn_search
+        from deeplearning4j_tpu.serving import KNNServer
+
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(50, 8)).astype(np.float32)
+        server = KNNServer(pts, port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            health = json.loads(urllib.request.urlopen(
+                f"{url}/health", timeout=10).read())
+            assert health["points"] == 50
+
+            q = pts[7] + 1e-4
+            req = urllib.request.Request(
+                f"{url}/knn",
+                data=json.dumps({"point": q.tolist(), "k": 3}).encode(),
+                headers={"Content-Type": "application/json"})
+            body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert body["results"][0]["index"] == 7
+            direct_i, direct_d = knn_search(pts, q[None], k=3)
+            assert [r["index"] for r in body["results"]] == \
+                list(np.asarray(direct_i)[0])
+
+            qs = pts[[3, 11]] + 1e-4
+            req = urllib.request.Request(
+                f"{url}/knnvec",
+                data=json.dumps({"vectors": qs.tolist(), "k": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert body["results"][0][0]["index"] == 3
+            assert body["results"][1][0]["index"] == 11
+
+            # bad request is a JSON 400, not a crash
+            req = urllib.request.Request(
+                f"{url}/knn", data=b'{"k": 1}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.stop()
+
+    def test_backends_agree(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.serving import KNNServer
+
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(40, 5)).astype(np.float32)
+        q = rng.normal(size=(5,)).astype(np.float32)
+        answers = []
+        for backend in ("vptree", "kdtree", "brute"):
+            s = KNNServer(pts, backend=backend)
+            answers.append([r["index"] for r in s._query_one(q, 4)])
+        assert answers[0] == answers[1] == answers[2]
