@@ -9,7 +9,8 @@ termination conditions (SURVEY.md §2.3 "Tooling" / §7 step 8).
 from deeplearning4j_tpu.arbiter.spaces import (
     ContinuousParameterSpace, DiscreteParameterSpace, IntegerParameterSpace,
 )
-from deeplearning4j_tpu.arbiter.spaces_net import MultiLayerSpace
+from deeplearning4j_tpu.arbiter.spaces_net import (ComputationGraphSpace,
+                                                   MultiLayerSpace)
 from deeplearning4j_tpu.arbiter.runner import (
     GridSearchGenerator, MaxCandidatesCondition, MaxTimeCondition,
     OptimizationResult, OptimizationRunner, RandomSearchGenerator,
@@ -17,7 +18,7 @@ from deeplearning4j_tpu.arbiter.runner import (
 
 __all__ = [
     "ContinuousParameterSpace", "DiscreteParameterSpace",
-    "IntegerParameterSpace", "MultiLayerSpace", "RandomSearchGenerator", "GridSearchGenerator",
+    "IntegerParameterSpace", "MultiLayerSpace", "ComputationGraphSpace", "RandomSearchGenerator", "GridSearchGenerator",
     "OptimizationRunner", "OptimizationResult", "MaxCandidatesCondition",
     "MaxTimeCondition",
 ]

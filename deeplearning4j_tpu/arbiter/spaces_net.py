@@ -106,3 +106,102 @@ class MultiLayerSpace:
     @staticmethod
     def builder() -> "MultiLayerSpace.Builder":
         return MultiLayerSpace.Builder()
+
+
+class ComputationGraphSpace:
+    """Graph-topology search space (org.deeplearning4j.arbiter
+    .ComputationGraphSpace analog): the graph builder idiom with
+    ParameterSpace-valued layer fields; ``sample`` draws every space and
+    builds a concrete ComputationGraphConfiguration. Vertices are fixed
+    topology (as in the reference); only layer hyperparameters vary.
+
+        space = (ComputationGraphSpace.builder()
+                 .add_inputs("in")
+                 .set_input_types(**{"in": InputType.feed_forward(10)})
+                 .add_layer("fc", DenseLayer(n_out=IntegerParameterSpace(8, 64),
+                                             activation="relu"), "in")
+                 .add_layer("out", OutputLayer(...), "fc")
+                 .set_outputs("out")
+                 .build())
+    """
+
+    def __init__(self, inputs, input_types, nodes, outputs, updater_fn=None,
+                 seed: int = 0):
+        self._inputs = inputs
+        self._input_types = input_types
+        self._nodes = nodes          # [(kind, name, layer_or_vertex, parents)]
+        self._outputs = outputs
+        self._updater_fn = updater_fn
+        self._rng = np.random.default_rng(seed)
+
+    def sample(self, rng=None):
+        rng = rng if rng is not None else self._rng
+        b = NeuralNetConfiguration.builder().seed(int(rng.integers(1 << 30)))
+        if self._updater_fn is not None:
+            b = b.updater(self._updater_fn(rng))
+        gb = (b.graph_builder()
+              .add_inputs(*self._inputs)
+              .set_input_types(**self._input_types))
+        for kind, name, obj, parents in self._nodes:
+            if kind == "layer":
+                gb = gb.add_layer(name, _sample_layer(obj, rng), *parents)
+            else:
+                gb = gb.add_vertex(name, obj, *parents)
+        return gb.set_outputs(*self._outputs).build()
+
+    def candidate_generator(self, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        while True:
+            yield {"conf": self.sample(rng)}
+
+    # --------------------------------------------------------------- builder
+    class Builder:
+        def __init__(self):
+            self._inputs: List[str] = []
+            self._input_types: Dict[str, InputType] = {}
+            self._nodes: List = []
+            self._outputs: List[str] = []
+            self._updater_fn = None
+            self._seed = 0
+
+        def add_inputs(self, *names: str) -> "ComputationGraphSpace.Builder":
+            self._inputs = list(names)
+            return self
+
+        def set_input_types(self, **types) -> "ComputationGraphSpace.Builder":
+            self._input_types.update(types)
+            return self
+
+        def add_layer(self, name: str, layer, *parents: str
+                      ) -> "ComputationGraphSpace.Builder":
+            self._nodes.append(("layer", name, layer, list(parents)))
+            return self
+
+        def add_vertex(self, name: str, vertex, *parents: str
+                       ) -> "ComputationGraphSpace.Builder":
+            self._nodes.append(("vertex", name, vertex, list(parents)))
+            return self
+
+        def set_outputs(self, *names: str) -> "ComputationGraphSpace.Builder":
+            self._outputs = list(names)
+            return self
+
+        def updater_space(self, fn) -> "ComputationGraphSpace.Builder":
+            self._updater_fn = fn
+            return self
+
+        def seed(self, s: int) -> "ComputationGraphSpace.Builder":
+            self._seed = s
+            return self
+
+        def build(self) -> "ComputationGraphSpace":
+            if not (self._inputs and self._outputs):
+                raise ValueError("ComputationGraphSpace requires inputs and "
+                                 "outputs")
+            return ComputationGraphSpace(self._inputs, self._input_types,
+                                         self._nodes, self._outputs,
+                                         self._updater_fn, seed=self._seed)
+
+    @staticmethod
+    def builder() -> "ComputationGraphSpace.Builder":
+        return ComputationGraphSpace.Builder()

@@ -12,12 +12,27 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
 import numpy as np
 
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 
+
+
+class _HttpServerMixin:
+    """Shared ephemeral-port resolution and shutdown for the HTTP servers."""
+
+    _httpd = None
+    _thread = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def _stop_httpd(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
 
 
 def _serve_json(host, port, post_routes, get_routes):
@@ -67,7 +82,7 @@ def _serve_json(host, port, post_routes, get_routes):
     return httpd, thread
 
 
-class ModelServer:
+class ModelServer(_HttpServerMixin):
     """Serve a model's output() via JSON HTTP.
 
         server = ModelServer(model, port=0).start()
@@ -81,12 +96,6 @@ class ModelServer:
         self._host, self._port = host, port
         self._timeout = queue_timeout
         self._pi = ParallelInference(model, batch_limit=batch_limit)
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1] if self._httpd else self._port
 
     def start(self) -> "ModelServer":
         self._pi.start()
@@ -105,14 +114,11 @@ class ModelServer:
         return self
 
     def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._stop_httpd()
         self._pi.stop()
 
 
-class KNNServer:
+class KNNServer(_HttpServerMixin):
     """Nearest-neighbors HTTP server.
 
     Reference analog: deeplearning4j-nearestneighbors-server's NearestNeighborsServer —
@@ -144,12 +150,6 @@ class KNNServer:
             self._tree = None
         else:
             raise ValueError("backend must be vptree|kdtree|brute")
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1] if self._httpd else self._port
 
     def _query_one(self, point, k):
         if self._tree is not None:
@@ -179,7 +179,4 @@ class KNNServer:
         return self
 
     def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._stop_httpd()

@@ -168,3 +168,65 @@ class TestEvaluationCalibration:
         c, a, counts = ev.reliability_curve()
         assert counts.sum() == n
         assert ev.expected_calibration_error() < 0.08
+
+
+class TestComputationGraphSpace:
+    def test_samples_build_and_train(self, rng):
+        import numpy as np
+
+        from deeplearning4j_tpu.arbiter import (ComputationGraphSpace,
+                                                IntegerParameterSpace)
+        from deeplearning4j_tpu.nn import ComputationGraph, InputType
+        from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optimize import Adam
+
+        space = (ComputationGraphSpace.builder()
+                 .add_inputs("in")
+                 .set_input_types(**{"in": InputType.feed_forward(6)})
+                 .updater_space(lambda r: Adam(lr=float(
+                     10 ** r.uniform(-3, -2))))
+                 .add_layer("fc1", DenseLayer(
+                     n_out=IntegerParameterSpace(8, 8), activation="relu"),
+                     "in")
+                 .add_layer("fc2", DenseLayer(n_out=8,
+                                              activation="identity"), "fc1")
+                 .add_vertex("res", ElementWiseVertex(op="add"), "fc2", "fc1")
+                 .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                               loss="mcxent"), "res")
+                 .set_outputs("out")
+                 .build())
+        # residual topology constrains fc1/fc2 widths to match, so this
+        # test pins them and checks candidates BUILD AND TRAIN; width
+        # variation is covered by test_space_fields_vary on a linear graph
+        for _ in range(4):
+            conf = space.sample()
+            model = ComputationGraph(conf).init()
+            x = rng.normal(size=(8, 6)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+            loss = model.fit_batch(({"in": x}, {"out": y}))
+            assert np.isfinite(loss)
+        # the updater space varied across candidates
+        lrs = {float(space.sample().updater.lr) for _ in range(6)}
+        assert len(lrs) > 1
+
+    def test_space_fields_vary(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.arbiter import (ComputationGraphSpace,
+                                                IntegerParameterSpace)
+        from deeplearning4j_tpu.nn import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        space = (ComputationGraphSpace.builder()
+                 .add_inputs("in")
+                 .set_input_types(**{"in": InputType.feed_forward(4)})
+                 .add_layer("fc", DenseLayer(
+                     n_out=IntegerParameterSpace(4, 64), activation="relu"),
+                     "in")
+                 .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                               loss="mcxent"), "fc")
+                 .set_outputs("out")
+                 .build())
+        outs = {space.sample().vertices["fc"].layer.n_out for _ in range(12)}
+        assert len(outs) > 1   # the parameter space is actually sampled
