@@ -32,6 +32,22 @@ def _sample_layer(layer, rng):
     return dataclasses.replace(layer, **repl) if repl else layer
 
 
+def _seeded_builder(rng, updater_fn):
+    """Shared sample() preamble: seeded base config + drawn updater."""
+    b = NeuralNetConfiguration.builder().seed(int(rng.integers(1 << 30)))
+    if updater_fn is not None:
+        b = b.updater(updater_fn(rng))
+    return b
+
+
+def _candidate_generator(space, seed):
+    """Infinite {'conf': sampled config} generator (RandomSearch over the
+    space), pluggable into OptimizationRunner."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {"conf": space.sample(rng)}
+
+
 class MultiLayerSpace:
     """Builder over layer templates with ParameterSpace-valued fields.
 
@@ -57,20 +73,13 @@ class MultiLayerSpace:
         # default to the instance rng so repeated sample() calls draw NEW
         # candidates (a fresh rng per call would resample the same point)
         rng = rng if rng is not None else self._rng
-        b = NeuralNetConfiguration.builder().seed(int(rng.integers(1 << 30)))
-        if self._updater_fn is not None:
-            b = b.updater(self._updater_fn(rng))
-        lb = b.list()
+        lb = _seeded_builder(rng, self._updater_fn).list()
         for layer in self._layers:
             lb = lb.layer(_sample_layer(layer, rng))
         return lb.set_input_type(self._input_type).build()
 
     def candidate_generator(self, seed: int = 0):
-        """Infinite generator of sampled configs (RandomSearch over the
-        space), pluggable into OptimizationRunner as hyperparams={'conf': c}."""
-        rng = np.random.default_rng(seed)
-        while True:
-            yield {"conf": self.sample(rng)}
+        return _candidate_generator(self, seed)
 
     # --------------------------------------------------------------- builder
     class Builder:
@@ -135,11 +144,9 @@ class ComputationGraphSpace:
         self._rng = np.random.default_rng(seed)
 
     def sample(self, rng=None):
+        # instance rng default, same contract as MultiLayerSpace.sample
         rng = rng if rng is not None else self._rng
-        b = NeuralNetConfiguration.builder().seed(int(rng.integers(1 << 30)))
-        if self._updater_fn is not None:
-            b = b.updater(self._updater_fn(rng))
-        gb = (b.graph_builder()
+        gb = (_seeded_builder(rng, self._updater_fn).graph_builder()
               .add_inputs(*self._inputs)
               .set_input_types(**self._input_types))
         for kind, name, obj, parents in self._nodes:
@@ -150,9 +157,7 @@ class ComputationGraphSpace:
         return gb.set_outputs(*self._outputs).build()
 
     def candidate_generator(self, seed: int = 0):
-        rng = np.random.default_rng(seed)
-        while True:
-            yield {"conf": self.sample(rng)}
+        return _candidate_generator(self, seed)
 
     # --------------------------------------------------------------- builder
     class Builder:

@@ -6,7 +6,7 @@ datavec ETL. Host-side numpy with async device prefetch — the TPU analog of
 DL4J's AsyncDataSetIterator prefetch thread.
 """
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import (
     DataSetIterator, ListDataSetIterator, ArrayDataSetIterator, AsyncPrefetchIterator,
 )
@@ -19,7 +19,7 @@ from deeplearning4j_tpu.datasets.real import (DigitsDataSetIterator,
                                               TabularDataSetIterator)
 
 __all__ = [
-    "DataSet", "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator", "ArrayDataSetIterator",
     "AsyncPrefetchIterator", "NormalizerStandardize", "NormalizerMinMaxScaler",
     "ImagePreProcessingScaler", "MnistDataSetIterator",
     "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator",

@@ -77,3 +77,57 @@ class DataSet:
             np.concatenate([d.features for d in datasets]),
             np.concatenate([d.labels for d in datasets]),
         )
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input / multi-output batch for ComputationGraph training.
+
+    Reference analog: org.nd4j.linalg.dataset.MultiDataSet (features[],
+    labels[], per-array masks). ``features``/``labels`` are lists ordered
+    like the graph's network_inputs/network_outputs (or dicts keyed by
+    name). Sequence masks: the graph threads ONE shared [B, T] mask through
+    every vertex (the common case — all sequence inputs share timing), so
+    a single mask is accepted; per-output mask lists must collapse to one.
+    """
+
+    features: "list | dict"
+    labels: "list | dict"
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def _arrays(self, x):
+        return list(x.values()) if isinstance(x, dict) else list(x)
+
+    def num_examples(self) -> int:
+        return int(self._arrays(self.features)[0].shape[0])
+
+    def shuffle(self, seed: Optional[int] = None) -> "MultiDataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+
+        def take(x):
+            if isinstance(x, dict):
+                return {k: v[idx] for k, v in x.items()}
+            return [v[idx] for v in x]
+
+        return MultiDataSet(
+            take(self.features), take(self.labels),
+            None if self.features_mask is None else self.features_mask[idx],
+            None if self.labels_mask is None else self.labels_mask[idx])
+
+    def batches(self, batch_size: int):
+        """Iterate MultiDataSet minibatches (MultiDataSetIterator analog)."""
+        n = self.num_examples()
+        for i in range(0, n, batch_size):
+            sl = slice(i, i + batch_size)
+
+            def take(x):
+                if isinstance(x, dict):
+                    return {k: v[sl] for k, v in x.items()}
+                return [v[sl] for v in x]
+
+            yield MultiDataSet(
+                take(self.features), take(self.labels),
+                None if self.features_mask is None else self.features_mask[sl],
+                None if self.labels_mask is None else self.labels_mask[sl])

@@ -263,21 +263,35 @@ class ComputationGraph:
             params, state, inputs, True, rng, masks=masks, want_preout=True)
         from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
 
+        # one shared [B, T] sequence mask (the same list contract the
+        # vertices consume); per-output losses apply it exactly like
+        # MultiLayerNetwork._loss_terms — masked per-sample sums
+        # normalized by the total valid-step count
+        out_mask = masks[0] if masks else None
         loss = 0.0
         for name in self.conf.network_outputs:
             v = self.conf.vertices[name]
             if name in preouts and hasattr(v.layer, "score_from_preout"):
-                per = v.layer.score_from_preout(labels[name], preouts[name], None)
+                per = v.layer.score_from_preout(labels[name], preouts[name],
+                                                out_mask)
                 if isinstance(v.layer, CenterLossOutputLayer):
                     cscore, cstate = v.layer.center_score_and_state(
                         params.get(name, {}), state.get(name, {}),
                         out_feats[name], labels[name])
                     per = per + cscore
                     new_state[name] = cstate
-                loss = loss + per.mean()
+                if (out_mask is not None and per.ndim == 1
+                        and out_mask.ndim >= 2):
+                    loss = loss + per.sum() / jnp.maximum(out_mask.sum(), 1.0)
+                else:
+                    loss = loss + per.mean()
             else:
                 d = acts[name] - labels[name]
-                loss = loss + (d * d).mean()
+                if out_mask is not None and d.ndim == 3:
+                    w = out_mask[..., None]
+                    loss = loss + ((d * d) * w).sum() /                         jnp.maximum(w.sum() * d.shape[-1], 1.0)
+                else:
+                    loss = loss + (d * d).mean()
         for name, v in self.conf.vertices.items():
             if isinstance(v, LayerVertex) and name in params:
                 loss = loss + v.layer.regularization(params[name])
@@ -324,10 +338,13 @@ class ComputationGraph:
         if fn is None:
             fn = self._make_train_step()
             self._jit_cache["train"] = fn
+        # vertices consume masks as a LIST (one shared [B, T] sequence
+        # mask threaded to every vertex; LayerVertex reads masks[0]) — a
+        # bare array would hit `if masks` truthiness inside the trace
         self.params, self.state, self.opt_state, loss = fn(
             self.params, self.state, self.opt_state,
             jnp.asarray(self.step_count, jnp.int32), inputs, labels, self._next_key(),
-            None if mask is None else jnp.asarray(mask))
+            None if mask is None else [jnp.asarray(mask)])
         self.score_value = float(loss)
         for lst in self.listeners:
             lst.iteration_done(self, self.step_count, self.epoch_count, self.score_value)

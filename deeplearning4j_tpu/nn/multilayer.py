@@ -545,7 +545,8 @@ class MultiLayerNetwork:
 
 
 def _unpack(ds):
-    """Accept DataSet-like (has .features/.labels), tuple, or dict."""
+    """Accept DataSet/MultiDataSet-like (has .features/.labels), tuple,
+    or dict."""
     if hasattr(ds, "features"):
         mask = getattr(ds, "labels_mask", None)
         if mask is None:

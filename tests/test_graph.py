@@ -154,3 +154,138 @@ class TestGraphRnnTimeStep:
         model.rnn_time_step(rng.normal(size=(4, 4)).astype(np.float32))
         with pytest.raises(ValueError, match="batch size changed"):
             model.rnn_time_step(rng.normal(size=(2, 4)).astype(np.float32))
+
+
+class TestMultiDataSet:
+    def test_two_input_two_output_fit(self, rng):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(lr=5e-3))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(**{"a": InputType.feed_forward(3),
+                                    "b": InputType.feed_forward(5)})
+                .add_layer("fa", DenseLayer(n_out=8, activation="relu"), "a")
+                .add_layer("fb", DenseLayer(n_out=8, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "fa", "fb")
+                .add_layer("o1", OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "m")
+                .add_layer("o2", OutputLayer(n_out=1, activation="identity",
+                                             loss="mse"), "m")
+                .set_outputs("o1", "o2")
+                .build())
+        model = ComputationGraph(conf).init()
+        n = 64
+        a = rng.normal(size=(n, 3)).astype(np.float32)
+        b = rng.normal(size=(n, 5)).astype(np.float32)
+        cls = (a[:, 0] + b[:, 0] > 0).astype(np.int64)
+        y1 = np.eye(2, dtype=np.float32)[cls]
+        y2 = (a[:, :1] - b[:, :1]).astype(np.float32)
+        mds = MultiDataSet([a, b], [y1, y2])
+        losses = []
+        for epoch in range(60):
+            for batch in mds.shuffle(seed=epoch).batches(32):
+                losses.append(model.fit_batch(batch))
+        assert losses[-1] < 0.4 * losses[0]
+        out1 = np.asarray(model.output({"a": a, "b": b})[0])
+        assert (out1.argmax(1) == cls).mean() > 0.9
+
+    def test_shuffle_keeps_alignment(self, rng):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+
+        a = np.arange(10, dtype=np.float32)[:, None]
+        b = a * 2
+        y = a * 3
+        mds = MultiDataSet([a, b], [y]).shuffle(seed=0)
+        fa, fb = mds.features
+        assert np.array_equal(fb, fa * 2)
+        assert np.array_equal(mds.labels[0], fa * 3)
+        assert mds.num_examples() == 10
+
+    def test_dict_form_and_batches(self, rng):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+
+        a = rng.normal(size=(7, 2)).astype(np.float32)
+        y = rng.normal(size=(7, 1)).astype(np.float32)
+        mds = MultiDataSet({"in": a}, {"out": y})
+        sizes = [m.num_examples() for m in mds.batches(3)]
+        assert sizes == [3, 3, 1]
+        first = next(iter(mds.batches(3)))
+        assert set(first.features.keys()) == {"in"}
+
+    def test_masked_sequence_fit(self, rng):
+        """Regression: graph fit_batch with a [B, T] mask used to crash on
+        array truthiness (vertices expect masks as a list)."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.layers import GravesLSTMLayer, RnnOutputLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(lr=5e-3))
+                .graph_builder()
+                .add_inputs("seq")
+                .set_input_types(**{"seq": InputType.recurrent(2, None)})
+                .add_layer("lstm", GravesLSTMLayer(n_out=8, activation="tanh"),
+                           "seq")
+                .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                                 loss="mcxent"), "lstm")
+                .set_outputs("out")
+                .build())
+        m = ComputationGraph(conf).init()
+        x = rng.normal(size=(8, 6, 2)).astype(np.float32)
+        y = np.zeros((8, 6, 2), np.float32)
+        y[..., 0] = 1.0
+        mask = np.ones((8, 6), np.float32)
+        mask[:, 4:] = 0.0
+        loss = m.fit_batch(MultiDataSet([x], [y], features_mask=mask,
+                                        labels_mask=mask))
+        assert np.isfinite(loss)
+
+    def test_mask_reaches_output_loss(self, rng):
+        """Changing labels ONLY at masked-out timesteps must not change
+        the loss, and the graph's masked loss must equal the MLN's on an
+        identical single-path model."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import GravesLSTMLayer, RnnOutputLayer
+
+        def graph_model():
+            conf = (NeuralNetConfiguration.builder().seed(9)
+                    .updater(Adam(lr=5e-3))
+                    .graph_builder()
+                    .add_inputs("seq")
+                    .set_input_types(**{"seq": InputType.recurrent(2, None)})
+                    .add_layer("lstm", GravesLSTMLayer(n_out=8,
+                                                       activation="tanh"),
+                               "seq")
+                    .add_layer("out", RnnOutputLayer(n_out=2,
+                                                     activation="softmax",
+                                                     loss="mcxent"), "lstm")
+                    .set_outputs("out")
+                    .build())
+            return ComputationGraph(conf).init()
+
+        x = rng.normal(size=(8, 6, 2)).astype(np.float32)
+        y = np.zeros((8, 6, 2), np.float32)
+        y[..., 0] = 1.0
+        mask = np.ones((8, 6), np.float32)
+        mask[:, 4:] = 0.0
+        y_garbage = y.copy()
+        y_garbage[:, 4:] = 7.5   # only masked-out steps differ
+
+        l1 = graph_model().fit_batch(MultiDataSet([x], [y],
+                                                  labels_mask=mask))
+        l2 = graph_model().fit_batch(MultiDataSet([x], [y_garbage],
+                                                  labels_mask=mask))
+        assert l1 == pytest.approx(l2, rel=1e-6), (l1, l2)
+
+        mln_conf = (NeuralNetConfiguration.builder().seed(9)
+                    .updater(Adam(lr=5e-3))
+                    .list()
+                    .layer(GravesLSTMLayer(n_out=8, activation="tanh"))
+                    .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"))
+                    .set_input_type(InputType.recurrent(2, None))
+                    .build())
+        mln = MultiLayerNetwork(mln_conf).init()
+        l3 = mln.fit_batch((x, y, mask))
+        assert l1 == pytest.approx(l3, rel=1e-5), (l1, l3)
