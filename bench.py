@@ -280,16 +280,30 @@ def make_mode(mode, batch):
         x = np.eye(V, dtype=np.float32)[ids]
         y = np.eye(V, dtype=np.float32)[np.roll(ids, -1, axis=1)]
         label = "Bidirectional GravesLSTM char-RNN train throughput"
-    elif mode == "bert":
+    elif mode in ("bert", "bert_long"):
         from deeplearning4j_tpu.zoo import BertBase
 
-        model = BertBase().init()
-        x = rng.integers(0, 30522, (batch, 128)).astype(np.int32)
+        T = 128 if mode == "bert" else 512
+        model = BertBase(max_len=T).init()
+        x = rng.integers(0, 30522, (batch, T)).astype(np.int32)
         y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch)]
-        label = "BERT-base fine-tune train throughput (seq 128)"
+        label = f"BERT-base fine-tune train throughput (seq {T})"
     else:
         raise ValueError(f"make_mode: unknown mode {mode!r}")
-    return make_mln(model, x, y), label
+    fn = make_mln(model, x, y)
+    if mode.startswith("bert"):
+        # record which attention impl the registry selects for this model's
+        # geometry (BERT-base: 12 heads, head_dim 64) — the VERDICT r3 #1
+        # evidence that BERT-class shapes ride (or don't ride) the kernel
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops import get_op
+
+        T = x.shape[1]
+        qshape = jnp.zeros((batch, 12, T, 64), jnp.bfloat16)
+        fn.attention_path = get_op("dot_product_attention").select(
+            qshape, qshape, qshape).platform
+    return fn, label
 
 
 def bench_longcontext(T=8192, rounds=3):
@@ -468,41 +482,66 @@ def bench_kernels(rounds=3, budget_deadline=None):
 
     rng = np.random.default_rng(0)
 
-    # ---- flash attention: fwd and train, T=4096 bf16
-    def flash_rows():
+    # ---- flash attention: fwd and train. D=128 long-T rows plus the r4
+    # D=64 rows (the BERT-class geometry, BASELINE config #4) and a masked
+    # row — the kernel now serves key-padding masks natively.
+    def _flash_rowfn():
         from deeplearning4j_tpu.ops.attention import dot_product_attention
         from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
 
-        B, H, T, D = 1, 4, 4096, 128
-        q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+        def rows(tag, B, H, T, D, fwd_iters, train_iters, *, causal=True,
+                 masked=False):
+            q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+            mask = None
+            if masked:
+                m = np.ones((B, T), np.float32)
+                m[:, int(T * 0.75):] = 0  # 25% padded batch
+                mask = jnp.asarray(m)[:, None, None, :]
 
-        # the carry REALLY feeds the input (x + acc*1e-12): acc*0 would be
-        # constant-folded and the whole loop body hoisted out of the
-        # while-loop, timing nothing
-        def fwd(attn):
-            def step(acc):
-                o = attn(q + (acc * 1e-12).astype(jnp.bfloat16), q, q)
-                return o.astype(jnp.float32).mean()
-            return step
+            # the carry REALLY feeds the input (x + acc*1e-12): acc*0 would
+            # be constant-folded and the whole loop body hoisted out of the
+            # while-loop, timing nothing
+            def fwd(attn):
+                def step(acc):
+                    o = attn(q + (acc * 1e-12).astype(jnp.bfloat16), q, q,
+                             mask=mask, causal=causal)
+                    return o.astype(jnp.float32).mean()
+                return step
 
-        table["flash_attention_fwd_T4096"] = _device_loop_ab(
-            lambda: fwd(lambda *a: flash_attention(*a, causal=True)),
-            lambda: fwd(lambda *a: dot_product_attention(*a, causal=True)),
-            iters=400, rounds=rounds)
+            def train(attn):
+                def step(acc):
+                    def loss(qq):
+                        return attn(qq, qq, qq, mask=mask,
+                                    causal=causal).astype(jnp.float32).var()
+                    return jax.grad(loss)(
+                        q + (acc * 1e-12).astype(jnp.bfloat16)
+                    ).astype(jnp.float32).mean()
+                return step
 
-        def train(attn):
-            def step(acc):
-                def loss(qq):
-                    return attn(qq, qq, qq).astype(jnp.float32).var()
-                return jax.grad(loss)(
-                    q + (acc * 1e-12).astype(jnp.bfloat16)
-                ).astype(jnp.float32).mean()
-            return step
+            table[f"flash_attention_fwd_{tag}"] = _device_loop_ab(
+                lambda: fwd(flash_attention),
+                lambda: fwd(dot_product_attention),
+                iters=fwd_iters, rounds=rounds)
+            table[f"flash_attention_train_{tag}"] = _device_loop_ab(
+                lambda: train(flash_attention),
+                lambda: train(dot_product_attention),
+                iters=train_iters, rounds=rounds)
 
-        table["flash_attention_train_T4096"] = _device_loop_ab(
-            lambda: train(lambda *a: flash_attention(*a, causal=True)),
-            lambda: train(lambda *a: dot_product_attention(*a, causal=True)),
-            iters=250, rounds=rounds)
+        return rows
+
+    def flash_rows():
+        rows = _flash_rowfn()
+        rows("T4096", 1, 4, 4096, 128, 400, 250)
+
+    def flash_d64_rows():
+        # BERT-base geometry (H=12, Dh=64): non-causal encoder attention
+        rows = _flash_rowfn()
+        rows("D64_T512", 8, 12, 512, 64, 600, 350, causal=False)
+        if not over_deadline():
+            rows("D64_T2048", 2, 12, 2048, 64, 300, 180, causal=False)
+        if not over_deadline():
+            rows("D64_T2048_masked", 2, 12, 2048, 64, 300, 180,
+                 causal=False, masked=True)
 
     # ---- fused LSTM: selected regime (nj==1) and demoted multi-tile regime
     def _lstm_rowfn():
@@ -625,7 +664,7 @@ def bench_kernels(rounds=3, budget_deadline=None):
             build_train(pallas_lrn), build_train(xla_lrn), iters=400,
             rounds=rounds)
 
-    for block in (flash_rows, lstm_rows, gru_rows, lrn_rows,
+    for block in (flash_rows, flash_d64_rows, lstm_rows, gru_rows, lrn_rows,
                   lstm_demoted_rows, gru_demoted_rows):
         if over_deadline():
             table["truncated"] = "deadline reached; remaining kernels skipped"
@@ -635,6 +674,84 @@ def bench_kernels(rounds=3, budget_deadline=None):
         except Exception as e:          # record, never kill the bench line
             table[f"error_{block.__name__}"] = f"{type(e).__name__}: {e}"
     return table
+
+
+def bench_smoke(budget_deadline=None):
+    """Mosaic-compile (not time) every Pallas kernel at a minimal selected
+    shape on the real chip; report pass/fail per kernel (VERDICT r3 #6).
+
+    The default test suite runs kernels through the CPU interpreter, so a
+    jax/libtpu upgrade that breaks Mosaic COMPILATION would otherwise only
+    surface as a perf-table failure late in a bench run. This block is
+    cheap (compile-only, served by the persistent cache on repeat runs),
+    runs first, and survives deadline truncation — cold-cache compiles are
+    bounded by a per-case deadline check so the block can never eat the
+    north-star line's budget."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": f"backend is {jax.default_backend()}, not tpu"}
+
+    rng = np.random.default_rng(0)
+
+    def r(*shape, dtype=jnp.float32):
+        return jnp.asarray(rng.normal(size=shape).astype(np.float32) * 0.1,
+                           dtype=dtype)
+
+    def cases():
+        from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
+        from deeplearning4j_tpu.ops.pallas.fused_gru import fused_gru_layer
+        from deeplearning4j_tpu.ops.pallas.fused_lstm import fused_lstm_layer
+        from deeplearning4j_tpu.ops.pallas.lrn import pallas_lrn
+
+        q64 = r(1, 1, 2048, 64, dtype=jnp.bfloat16)
+        q128 = r(1, 1, 2048, 128, dtype=jnp.bfloat16)
+        km = jnp.ones((1, 2048), jnp.float32)
+        yield "flash_fwd_d64", lambda: flash_attention(q64, q64, q64)
+        yield "flash_fwd_d128_causal", lambda: flash_attention(
+            q128, q128, q128, causal=True)
+        yield "flash_fwd_masked", lambda: flash_attention(
+            q64, q64, q64, mask=km)
+        yield "flash_bwd_d64", lambda: jax.grad(
+            lambda q: flash_attention(q, q, q).astype(jnp.float32).sum())(q64)
+        yield "flash_bwd_masked", lambda: jax.grad(
+            lambda q: flash_attention(q, q, q, mask=km).astype(
+                jnp.float32).sum())(q64)
+
+        x = r(8, 4, 32)
+        h0 = jnp.zeros((8, 256))
+        Wl, Rl, bl = r(32, 1024), r(256, 1024), jnp.zeros((1024,))
+        yield "lstm_fwd", lambda: fused_lstm_layer(x, h0, h0, Wl, Rl, bl)[0]
+        yield "lstm_bwd", lambda: jax.grad(
+            lambda W: fused_lstm_layer(x, h0, h0, W, Rl, bl)[0].sum())(Wl)
+        Wg, Rg, bg = r(32, 768), r(256, 768), jnp.zeros((768,))
+        yield "gru_fwd", lambda: fused_gru_layer(x, h0, Wg, Rg, bg)[0]
+        yield "gru_bwd", lambda: jax.grad(
+            lambda W: fused_gru_layer(x, h0, W, Rg, bg)[0].sum())(Wg)
+
+        xl = r(4, 32, 32, 64)
+        yield "lrn_fwd", lambda: pallas_lrn(xl)
+        yield "lrn_bwd", lambda: jax.grad(
+            lambda a: (pallas_lrn(a) ** 2).sum())(xl)
+
+    out = {}
+    for name, thunk in cases():
+        if (budget_deadline is not None
+                and time.perf_counter() > budget_deadline):
+            out["truncated"] = "deadline reached; remaining compiles skipped"
+            break
+        t0 = time.perf_counter()
+        try:
+            jax.jit(thunk).lower().compile()
+            out[name] = {"ok": True,
+                         "compile_s": round(time.perf_counter() - t0, 2)}
+        except Exception as e:
+            out[name] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    out["all_ok"] = all(v.get("ok") for v in out.values()
+                        if isinstance(v, dict))
+    return out
 
 
 def bench_pipeline(batch=256, n=2048, hw=256, crop=224, epochs=3):
@@ -713,6 +830,21 @@ def main():
             "threads": out["threads"],
         }))
         return
+    if mode == "smoke":
+        table = bench_smoke(budget_deadline=deadline)
+        skipped = "skipped" in table
+        print(json.dumps({
+            "metric": "Pallas kernel Mosaic compile smoke "
+                      "(%d kernels)" % sum(1 for v in table.values()
+                                           if isinstance(v, dict) and "ok" in v),
+            # null = environment skip (non-TPU backend), NOT a compile
+            # failure; 0.0 means a kernel really failed to compile
+            "value": None if skipped else (1.0 if table.get("all_ok") else 0.0),
+            "unit": "all_ok",
+            "vs_baseline": None,
+            "smoke": table,
+        }))
+        return
     if mode == "kernels":
         table = bench_kernels(rounds=rounds, budget_deadline=deadline)
         speedups = [v["speedup"] for v in table.values()
@@ -731,11 +863,11 @@ def main():
         }))
         return
     if mode != "resnet50":
-        defaults = {"lenet": 512, "lstm": 64, "bert": 32}
+        defaults = {"lenet": 512, "lstm": 64, "bert": 32, "bert_long": 16}
         if mode not in defaults:
             raise SystemExit(
                 f"unknown bench mode '{mode}' (expected resnet50|lenet|lstm|"
-                f"bert|longcontext|pipeline|kernels)")
+                f"bert|bert_long|longcontext|pipeline|kernels|smoke)")
         batch = batch or defaults[mode]
         fn, label = make_mode(mode, batch)
         runs = [fn() for _ in range(rounds)]
@@ -744,7 +876,7 @@ def main():
         # per-round
         runs2 = [fn() for _ in range(rounds)]
         st1, st2 = _stats(runs), _stats(runs2)
-        print(json.dumps({
+        out = {
             "metric": "%s (zoo entrypoint, batch %d, median of %d rounds)"
                       % (label, batch, rounds),
             "value": st1["median"],
@@ -752,7 +884,10 @@ def main():
             "vs_baseline": None,
             "dispersion": st1,
             "remeasure": st2,
-        }))
+        }
+        if getattr(fn, "attention_path", None):
+            out["attention_path"] = fn.attention_path
+        print(json.dumps(out))
         return
     batch = batch or 256
 
@@ -819,9 +954,14 @@ def main():
         "dispersion": _stats(extra[0]),
     }
     # optional blocks, each within the bench deadline so the driver's
-    # timeout can never lose the north-star line. The per-kernel table is
-    # the most valuable attachment, so it goes FIRST (compiles are served
-    # by the persistent cache after the first run on a host).
+    # timeout can never lose the north-star line. The smoke block goes
+    # FIRST (compile-only, cache-served, survives truncation); then the
+    # per-kernel table — the most valuable attachment.
+    if time.perf_counter() < deadline - 60:
+        try:
+            result["smoke"] = bench_smoke(budget_deadline=deadline - 30)
+        except Exception:
+            pass
     if time.perf_counter() < deadline - 90:
         try:    # per-kernel speedup table (VERDICT r2 #2); bench_kernels
             # stops at its own sub-deadline and records a truncation

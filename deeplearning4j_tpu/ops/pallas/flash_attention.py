@@ -50,8 +50,13 @@ def _sds(shape, dtype, vma=None):
 # --------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                  *, causal, scale, block_q, block_k, seq_k):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal, scale, block_q, block_k,
+                  seq_k, has_kmask):
+    if has_kmask:
+        km_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        km_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -79,6 +84,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                                                        (block_q, block_k), 1)
         # mask the ragged tail block (out-of-bounds key columns read padding)
         s = jnp.where(kpos < seq_k, s, -jnp.inf)
+        if km_ref is not None:
+            # key-padding mask [1, bk]: broadcast over the q rows. The
+            # existing -inf machinery (m_safe / p guard / lse=+inf) already
+            # handles rows where every key is masked.
+            s = jnp.where(km_ref[0] > 0, s, -jnp.inf)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                            (block_q, block_k), 0)
@@ -112,8 +122,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret,
-                   vma=None):
-    """Returns (out [B,H,Tq,D], lse [B,H,Tq,1] float32)."""
+                   kmask=None, vma=None):
+    """Returns (out [B,H,Tq,D], lse [B,H,Tq,1] float32).
+
+    ``kmask``: optional key-padding mask [B, Tk] (>0 = key visible) — the
+    shape DL4J's per-example feature masks reduce to; blocked per (batch,
+    k-block) with the batch index derived as ``b // H`` from the flattened
+    batch*head grid axis, so the mask is never materialized per-head."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(block_q, Tq)
@@ -122,20 +137,30 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret,
     kf = k.reshape(B * H, Tk, D)
     vf = v.reshape(B * H, Tk, D)
     grid = (B * H, pl.cdiv(Tq, bq), pl.cdiv(Tk, bk))
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [qf, kf, vf]
+    if kmask is not None:
+        # [B, 1, Tk] so the block's trailing dims are (1, bk) — Mosaic's
+        # (8, 128)-divisibility rule applies to the last two dims and a
+        # middle dim of exactly 1 satisfies the equal-to-array case
+        in_specs.append(pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H, 0, j),
+                                     memory_space=pltpu.VMEM))
+        operands.append(kmask.astype(jnp.float32).reshape(B, 1, Tk))
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, causal=causal, scale=scale,
-                          block_q=bq, block_k=bk, seq_k=Tk),
+                          block_q=bq, block_k=bk, seq_k=Tk,
+                          has_kmask=kmask is not None),
         out_shape=(_sds(qf.shape, q.dtype, vma),
                    _sds((B * H, Tq, 1), jnp.float32, vma)),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
@@ -148,7 +173,7 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*operands)
     return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq, 1)
 
 
@@ -157,7 +182,7 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret,
 # --------------------------------------------------------------------------
 
 
-def _recompute_p(q_ref, k_ref, lse_ref, *, qi, ki, causal, scale,
+def _recompute_p(q_ref, k_ref, lse_ref, km_ref, *, qi, ki, causal, scale,
                  block_q, block_k, seq_q, seq_k):
     """Recompute one [bq, bk] probability tile exp(s - lse), fully masked."""
     q = q_ref[0]
@@ -172,13 +197,20 @@ def _recompute_p(q_ref, k_ref, lse_ref, *, qi, ki, causal, scale,
     kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                    (block_q, block_k), 1)
     valid = (qpos < seq_q) & (kpos < seq_k)
+    if km_ref is not None:
+        valid &= km_ref[0] > 0                            # [1, bk] broadcast
     if causal:
         valid &= qpos >= kpos
     return jnp.where(valid, p, 0.0), k, valid
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                     dq_scr, *, causal, scale, block_q, block_k, seq_q, seq_k):
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                     causal, scale, block_q, block_k, seq_q, seq_k, has_kmask):
+    if has_kmask:
+        km_ref, dq_ref, dq_scr = rest
+    else:
+        km_ref = None
+        dq_ref, dq_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -191,7 +223,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(visible)
     def _body():
-        p, k, valid = _recompute_p(q_ref, k_ref, lse_ref, qi=qi, ki=ki,
+        p, k, valid = _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi=qi, ki=ki,
                                    causal=causal, scale=scale, block_q=block_q,
                                    block_k=block_k, seq_q=seq_q, seq_k=seq_k)
         do = do_ref[0]
@@ -210,9 +242,14 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale,
-                      block_q, block_k, seq_q, seq_k):
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                      causal, scale, block_q, block_k, seq_q, seq_k,
+                      has_kmask):
+    if has_kmask:
+        km_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        km_ref = None
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -226,7 +263,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _body():
-        p, _, valid = _recompute_p(q_ref, k_ref, lse_ref, qi=qi, ki=ki,
+        p, _, valid = _recompute_p(q_ref, k_ref, lse_ref, km_ref, qi=qi, ki=ki,
                                    causal=causal, scale=scale, block_q=block_q,
                                    block_k=block_k, seq_q=seq_q, seq_k=seq_k)
         q = q_ref[0]
@@ -254,7 +291,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, do, lse, delta, *, causal, scale, block_q,
-                    block_k, interpret, vma=None):
+                    block_k, interpret, kmask=None, vma=None):
     """O(T*D)-memory flash backward. lse/delta: [B,H,Tq,1] float32.
 
     Returns (dq, dk, dv) in float32 (callers cast to input dtypes)."""
@@ -268,6 +305,8 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, scale, block_q,
     dof = do.reshape(B * H, Tq, D)
     lsef = lse.reshape(B * H, Tq, 1)
     deltaf = delta.reshape(B * H, Tq, 1)
+    has_km = kmask is not None
+    kmf = kmask.astype(jnp.float32).reshape(B, 1, Tk) if has_km else None
 
     q_spec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                           memory_space=pltpu.VMEM)
@@ -275,17 +314,24 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, scale, block_q,
                           memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    operands = [qf, kf, vf, dof, lsef, deltaf]
+    if has_km:
+        in_specs.append(pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // H, 0, j),
+                                     memory_space=pltpu.VMEM))
+        operands.append(kmf)
 
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, causal=causal, scale=scale,
-                          block_q=bq, block_k=bk, seq_q=Tq, seq_k=Tk),
+                          block_q=bq, block_k=bk, seq_q=Tq, seq_k=Tk,
+                          has_kmask=has_km),
         out_shape=_sds(qf.shape, jnp.float32, vma),
         grid=(B * H, pl.cdiv(Tq, bq), pl.cdiv(Tk, bk)),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*operands)
 
     # k-blocks outer, q-blocks inner: index maps swap i<->j roles
     q_spec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0),
@@ -294,18 +340,24 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, scale, block_q,
                            memory_space=pltpu.VMEM)
     row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
                              memory_space=pltpu.VMEM)
+    in_specs2 = [q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2]
+    if has_km:
+        in_specs2.append(pl.BlockSpec((1, 1, bk),
+                                      lambda b, j, i: (b // H, 0, j),
+                                      memory_space=pltpu.VMEM))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, causal=causal, scale=scale,
-                          block_q=bq, block_k=bk, seq_q=Tq, seq_k=Tk),
+                          block_q=bq, block_k=bk, seq_q=Tq, seq_k=Tk,
+                          has_kmask=has_km),
         out_shape=(_sds(kf.shape, jnp.float32, vma),
                    _sds(vf.shape, jnp.float32, vma)),
         grid=(B * H, pl.cdiv(Tk, bk), pl.cdiv(Tq, bq)),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=in_specs2,
         out_specs=(k_spec2, k_spec2),
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*operands)
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
 
@@ -316,20 +368,20 @@ def _flash_backward(q, k, v, do, lse, delta, *, causal, scale, block_q,
 
 
 def flash_block_fwd(q, k, v, *, causal, scale, block_q=512, block_k=1024,
-                    vma=None):
+                    kmask=None, vma=None):
     """(o, lse) for one attention block pair; lse is [B,H,Tq,1] float32."""
     return _flash_forward(q, k, v, causal=causal, scale=scale,
                           block_q=block_q, block_k=block_k,
-                          interpret=_interpret(), vma=vma)
+                          interpret=_interpret(), kmask=kmask, vma=vma)
 
 
 def flash_block_bwd(q, k, v, do, lse, delta, *, causal, scale,
-                    block_q=1024, block_k=1024, vma=None):
+                    block_q=1024, block_k=1024, kmask=None, vma=None):
     """(dq, dk, dv) float32 given the (possibly global) lse and
     delta = rowsum(do * o)."""
     return _flash_backward(q, k, v, do, lse, delta, causal=causal,
                            scale=scale, block_q=block_q, block_k=block_k,
-                           interpret=_interpret(), vma=vma)
+                           interpret=_interpret(), kmask=kmask, vma=vma)
 
 
 # --------------------------------------------------------------------------
@@ -337,19 +389,19 @@ def flash_block_bwd(q, k, v, do, lse, delta, *, causal, scale,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kmask, causal, scale, block_q, block_k):
     out, _ = _flash_forward(q, k, v, causal=causal, scale=scale,
                             block_q=block_q, block_k=block_k,
-                            interpret=_interpret())
+                            interpret=_interpret(), kmask=kmask)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, kmask, causal, scale, block_q, block_k):
     out, lse = _flash_forward(q, k, v, causal=causal, scale=scale,
                               block_q=block_q, block_k=block_k,
-                              interpret=_interpret())
-    return out, (q, k, v, out, lse)
+                              interpret=_interpret(), kmask=kmask)
+    return out, (q, k, v, kmask, out, lse)
 
 
 def bwd_tiles(block_q, block_k, head_dim, vmem_budget=15 << 20):
@@ -381,17 +433,52 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
     # flash backward: only [bq, bk] probability tiles are ever materialized,
     # recomputed from the saved logsumexp — HBM stays O(T*D), which is what
     # makes long-context *training* (not just inference) sub-quadratic
-    q, k, v, out, lse = res
+    q, k, v, kmask, out, lse = res
     bq, bk = bwd_tiles(block_q, block_k, q.shape[-1])
     delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(
         axis=-1, keepdims=True)
     dq, dk, dv = _flash_backward(q, k, v, g, lse, delta, causal=causal,
                                  scale=scale, block_q=bq, block_k=bk,
-                                 interpret=_interpret())
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+                                 interpret=_interpret(), kmask=kmask)
+    dkm = None if kmask is None else jnp.zeros_like(kmask)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dkm
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _as_key_padding(mask, batch, seq_k):
+    """Reduce a broadcastable-to-[B,H,Tq,Tk] mask to a [B, Tk] key-padding
+    mask, or return None (mask=None) / raise (not expressible).
+
+    DL4J feature masks arrive as [B, Tk] per-example time masks; the layer
+    tier (nn/layers/attention.py:_attn_mask) lifts them to [B,1,1,Tk]. Both
+    forms — plus head/query-broadcast variants — reduce losslessly."""
+    if mask is None:
+        return None
+    m = jnp.asarray(mask)
+    if m.ndim == 4 and m.shape[1] == 1 and m.shape[2] == 1:
+        m = m[:, 0, 0, :]
+    elif m.ndim != 2:
+        raise ValueError(
+            f"flash_attention supports key-padding masks ([B, Tk] or "
+            f"[B, 1, 1, Tk]); got mask shape {mask.shape} — the registry "
+            f"predicate routes general masks to the XLA lowering")
+    if m.shape[-1] != seq_k:
+        raise ValueError(f"mask key axis {m.shape[-1]} != Tk {seq_k}")
+    m = jnp.broadcast_to(m, (batch, seq_k))
+    return m.astype(jnp.float32)
+
+
+def _is_key_padding(mask, q, k):
+    if mask is None:
+        return True
+    shp = tuple(mask.shape)
+    if len(shp) == 4:
+        return (shp[1] == 1 and shp[2] == 1 and shp[3] == k.shape[-2]
+                and shp[0] in (1, q.shape[0]))
+    return (len(shp) == 2 and shp[1] == k.shape[-2]
+            and shp[0] in (1, q.shape[0]))
 
 
 def flash_attention(q, k, v, *, mask=None, scale=None, causal=False,
@@ -401,26 +488,40 @@ def flash_attention(q, k, v, *, mask=None, scale=None, causal=False,
     Default tiles are the v5e sweet spot measured at T=8192 (fwd 512x1024,
     bwd 1024x1024 via _flash_bwd): small 128-tiles leave >2x on the table —
     grid overhead dominates; 2048-tiles exceed the 16M VMEM scoped limit.
-    Tiles clamp to the actual sequence lengths for short inputs."""
-    if mask is not None:
-        raise ValueError("flash_attention kernel handles mask=None only "
-                         "(causal flag supported); registry predicate "
-                         "routes masked calls to the XLA lowering")
+    Tiles clamp to the actual sequence lengths for short inputs.
+
+    ``mask`` accepts key-padding masks ([B, Tk] or the layer tier's
+    [B, 1, 1, Tk]); general [Tq, Tk]-varying masks are structurally
+    rejected (registry routes them to the XLA lowering)."""
+    km = _as_key_padding(mask, q.shape[0], k.shape[-2])
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _flash(q, k, v, causal, float(scale), block_q, block_k)
+    return _flash(q, k, v, km, causal, float(scale), block_q, block_k)
 
 
 def _flash_requires(q, k, v, *, mask=None, scale=None, causal=False, **kw):
-    # structural: the kernel cannot express masks, and its causal mask is
-    # start-aligned (query i sees keys <= i) which only matches the XLA
-    # lowering's end-aligned tril when Tq == Tk
-    return mask is None and (not causal or q.shape[-2] == k.shape[-2])
+    # structural: masks are supported iff they reduce to a key-padding mask
+    # over Tk; the kernel's causal mask is start-aligned (query i sees keys
+    # <= i) which only matches the XLA lowering's end-aligned tril when
+    # Tq == Tk
+    return (_is_key_padding(mask, q, k)
+            and (not causal or q.shape[-2] == k.shape[-2]))
 
 
 def _flash_applicable(q, k, v, *, mask=None, scale=None, causal=False, **kw):
-    # perf heuristic: long-sequence, lane/block-aligned shapes
-    return (q.shape[-2] >= 512 and q.shape[-1] % 128 == 0
+    # perf heuristic: long-sequence, lane/block-aligned shapes. head_dim 64
+    # (the BERT-class geometry) runs natively: the QK^T contraction fills
+    # half the MXU's K dimension but the kernel's win is HBM traffic, and
+    # the P@V / dV contractions (over bk) stay full-rate.
+    #
+    # The T >= 2048 threshold is MEASURED, not assumed (r4, v5e two-point
+    # A/B, BASELINE.md): at T=512/1024 XLA's fused attention wins (0.27x-
+    # 0.92x for the kernel across D=64/128, fwd and train — the [T,T]
+    # scores still fit on-chip and the kernel's grid overhead dominates);
+    # from T=2048 the kernel wins ~1.7x and grows with T (2.7-2.9x at
+    # 4096). The r1-r3 threshold of 512 was selecting the kernel in
+    # regimes where it loses.
+    return (q.shape[-2] >= 2048 and q.shape[-1] % 64 == 0
             and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0)
 
 
