@@ -466,15 +466,20 @@ def _device_loop_ab(build_kernel, build_xla, *, iters=30, rounds=3):
 
 
 def bench_kernels(rounds=3, budget_deadline=None):
-    """Per-kernel speedup table: flash attention (fwd + train), fused LSTM
-    (fwd + train, in its selected regime AND the demoted multi-tile regime),
-    LRN (AlexNet shape). Each entry records kernel-vs-XLA on this chip."""
+    """Per-kernel speedup table: flash attention (fwd + train, incl. the r4
+    D=64/masked rows and the measured-demoted short-T rows), fused LSTM and
+    GRU (all selected regimes incl. the r4 batch-blocked B=256/H=1024),
+    LRN (AlexNet shape, fwd + the r4 backward-kernel train row). Each entry
+    records kernel-vs-XLA on this chip. Rounds are capped at 2: the
+    two-point protocol already cancels fixed costs, and the cap keeps the
+    FULL table inside the bench deadline (the r3 table was truncated)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from deeplearning4j_tpu.common.env import env
 
+    rounds = min(rounds, 2)
     table = {}
 
     def over_deadline():
@@ -584,9 +589,10 @@ def bench_kernels(rounds=3, budget_deadline=None):
         rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
         if not over_deadline():
             rows("B32_H1024", 32, 64, 256, 1024, 150)   # selected (R resident)
-
-    def lstm_demoted_rows():
-        _lstm_rowfn()("B256_H1024", 256, 64, 512, 1024, 60)  # demoted (nj>1)
+        if not over_deadline():
+            # selected since r4: batch-blocked plan (fwd Bc=64/32, bwd
+            # (64,512)) — was the demoted nj>1 regime in r3
+            rows("B256_H1024", 256, 64, 512, 1024, 60)
 
     # ---- fused GRU: same regimes as the LSTM (3-gate cell, same policy)
     def _gru_rowfn():
@@ -627,9 +633,8 @@ def bench_kernels(rounds=3, budget_deadline=None):
         rows("B64_H256", 64, 64, 128, 256, 1500)        # selected (nj==1)
         if not over_deadline():
             rows("B64_H1024", 64, 64, 256, 1024, 150)   # selected (R resident)
-
-    def gru_demoted_rows():
-        _gru_rowfn()("B256_H1024", 256, 64, 512, 1024, 60)  # multi-tile check
+        if not over_deadline():
+            rows("B256_H1024", 256, 64, 512, 1024, 60)  # selected since r4
 
     # ---- LRN, AlexNet conv2 shape. The impl fns are captured at BUILD
     # time (pallas_lrn directly vs the registered xla lowering) — selecting
@@ -664,8 +669,8 @@ def bench_kernels(rounds=3, budget_deadline=None):
             build_train(pallas_lrn), build_train(xla_lrn), iters=400,
             rounds=rounds)
 
-    for block in (flash_rows, flash_d64_rows, lstm_rows, gru_rows, lrn_rows,
-                  lstm_demoted_rows, gru_demoted_rows):
+    for block in (flash_rows, flash_d64_rows, lstm_rows, gru_rows,
+                  lrn_rows):
         if over_deadline():
             table["truncated"] = "deadline reached; remaining kernels skipped"
             break
@@ -731,6 +736,22 @@ def bench_smoke(budget_deadline=None):
         yield "gru_bwd", lambda: jax.grad(
             lambda W: fused_gru_layer(x, h0, W, Rg, bg)[0].sum())(Wg)
 
+        # r4 batch-blocked plans (nb > 1): B=256/H=1024 compiles the
+        # fwd Bc=32/64 and bwd (64,512) grids at T=2 (compile-only check;
+        # the timed A/B runs the real T=64 shape)
+        xb = r(256, 2, 64)
+        hb0 = jnp.zeros((256, 1024))
+        Wb, Rb, bb = r(64, 4096), r(1024, 4096), jnp.zeros((4096,))
+        yield "lstm_fwd_batchblocked", lambda: fused_lstm_layer(
+            xb, hb0, hb0, Wb, Rb, bb)[0]
+        yield "lstm_bwd_batchblocked", lambda: jax.grad(
+            lambda W: fused_lstm_layer(xb, hb0, hb0, W, Rb, bb)[0].sum())(Wb)
+        Wbg, Rbg, bbg = r(64, 3072), r(1024, 3072), jnp.zeros((3072,))
+        yield "gru_fwd_batchblocked", lambda: fused_gru_layer(
+            xb, hb0, Wbg, Rbg, bbg)[0]
+        yield "gru_bwd_batchblocked", lambda: jax.grad(
+            lambda W: fused_gru_layer(xb, hb0, W, Rbg, bbg)[0].sum())(Wbg)
+
         xl = r(4, 32, 32, 64)
         yield "lrn_fwd", lambda: pallas_lrn(xl)
         yield "lrn_bwd", lambda: jax.grad(
@@ -749,8 +770,11 @@ def bench_smoke(budget_deadline=None):
                          "compile_s": round(time.perf_counter() - t0, 2)}
         except Exception as e:
             out[name] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
-    out["all_ok"] = all(v.get("ok") for v in out.values()
-                        if isinstance(v, dict))
+    compiled = [v for v in out.values() if isinstance(v, dict) and "ok" in v]
+    # all_ok asserts a COMPLETE green pass: an empty/truncated run is not
+    # evidence that the kernels compile
+    out["all_ok"] = (bool(compiled) and "truncated" not in out
+                     and all(v["ok"] for v in compiled))
     return out
 
 

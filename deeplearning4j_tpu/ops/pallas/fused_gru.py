@@ -49,10 +49,11 @@ def _gru_kernel(xg_ref, r_ref, h0_ref, out_ref, hT_ref, *rest, hb,
         hprev_scr, hnext_scr = rest[4:]
     else:
         hprev_scr, hnext_scr = rest
-    t = pl.program_id(0)
-    j = pl.program_id(1)
-    nt = pl.num_programs(0)
-    nj = pl.num_programs(1)
+    # grid (nb, T, nj): batch block outermost (r4) — see fused_lstm.py
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    nt = pl.num_programs(1)
+    nj = pl.num_programs(2)
 
     @pl.when((t == 0) & (j == 0))
     def _init():
@@ -89,10 +90,11 @@ def _gru_kernel(xg_ref, r_ref, h0_ref, out_ref, hT_ref, *rest, hb,
 
 
 def gru_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
-    """Largest hidden tile (multiple of 128, dividing H) whose working set
-    fits the VMEM budget; None when even Hb=128 does not fit. Same
-    accounting discipline as fused_lstm.lstm_tile (grid-varying blocks are
-    double-buffered by the pipeline and count twice)."""
+    """Largest hidden tile (multiple of 128, dividing H) for a batch block
+    of B rows; None when even Hb=128 does not fit. Same accounting
+    discipline as fused_lstm.lstm_tile (grid-varying blocks are
+    double-buffered by the pipeline and count twice; batch-block-only
+    variation re-fetches at chunk boundaries and counts once)."""
     for hb in (H, 1024, 512, 256, 128):
         if hb > H or H % hb:
             continue
@@ -101,7 +103,7 @@ def gru_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
                + 2 * B * 3 * hb * 4            # xg block (dbl-buffered)
                + 2 * 2 * B * hb * 4            # out/hT tiles (dbl)
                + 2 * B * H * 4                 # h double buffer
-               + B * H * 4)                    # h0 (invariant)
+               + B * H * 4)                    # h0 (refetch amortized)
         if save_residuals:
             est += 2 * 4 * B * hb * 4          # r/z/n/hgn tiles (dbl)
         if est <= budget:
@@ -117,11 +119,24 @@ def gru_bwd_tile(B, H, rdtype_bytes=2, budget=13 << 20):
         est = (r_bufs * H * 3 * hb * rdtype_bytes  # R^T panel
                + 2 * 6 * B * hb * 4            # r/z/n/hgn/hprev/dout (dbl)
                + 2 * 3 * B * hb * 4            # dgr/dgz/dgn out tiles (dbl)
-               + B * H * 4                     # dh0: full-H invariant block
+               + B * H * 4                     # dh0 full-H block
                + 2 * B * H * 4)                # dh carry + dh accumulator
         if est <= budget:
             return hb
     return None
+
+
+def gru_plan(B, H, rdtype_bytes=2, save_residuals=False):
+    from deeplearning4j_tpu.ops.pallas.fused_lstm import _plan
+
+    return _plan(gru_tile, B, H, rdtype_bytes=rdtype_bytes,
+                 save_residuals=save_residuals)
+
+
+def gru_bwd_plan(B, H, rdtype_bytes=2):
+    from deeplearning4j_tpu.ops.pallas.fused_lstm import _bwd_plan
+
+    return _bwd_plan(gru_bwd_tile, B, H, rdtype_bytes=rdtype_bytes)
 
 
 def _fused_gru_recurrence(xg, R, h0, *, interpret, save_residuals=False):
@@ -131,23 +146,25 @@ def _fused_gru_recurrence(xg, R, h0, *, interpret, save_residuals=False):
     T, B, G = xg.shape
     H = G // 3
     pdt = _panel_dtype(R.dtype)
-    hb = gru_tile(B, H, rdtype_bytes=jnp.dtype(pdt).itemsize,
-                  save_residuals=save_residuals)
+    Bc, hb = gru_plan(B, H, rdtype_bytes=jnp.dtype(pdt).itemsize,
+                      save_residuals=save_residuals)
     if hb is None:
         raise ValueError(f"no VMEM-feasible GRU tile for B={B}, H={H}")
+    nb = B // Bc
     nj = H // hb
     Rl = (R.reshape(H, 3, nj, hb).transpose(2, 0, 1, 3)
           .reshape(nj, H, 3 * hb).astype(pdt))
     xgl = (xg.reshape(T, B, 3, nj, hb).transpose(0, 3, 1, 2, 4)
            .reshape(T, nj, B, 3 * hb))
 
-    tile_tj = pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
+    tile_tj = pl.BlockSpec((1, Bc, hb), lambda b, t, j: (t, b, j),
                            memory_space=pltpu.VMEM)
     out_shape = [jax.ShapeDtypeStruct((T, B, H), xg.dtype),
                  jax.ShapeDtypeStruct((B, H), xg.dtype)]
     out_specs = [
         tile_tj,
-        pl.BlockSpec((B, hb), lambda t, j: (0, j), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Bc, hb), lambda b, t, j: (b, j),
+                     memory_space=pltpu.VMEM),
     ]
     if save_residuals:
         for _ in range(4):                     # r, z, n, hg_n
@@ -157,19 +174,19 @@ def _fused_gru_recurrence(xg, R, h0, *, interpret, save_residuals=False):
     res = pl.pallas_call(
         functools.partial(_gru_kernel, hb=hb, save_residuals=save_residuals),
         out_shape=tuple(out_shape),
-        grid=(T, nj),
+        grid=(nb, T, nj),
         in_specs=[
-            pl.BlockSpec((1, 1, B, 3 * hb), lambda t, j: (t, j, 0, 0),
+            pl.BlockSpec((1, 1, Bc, 3 * hb), lambda b, t, j: (t, j, b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, H, 3 * hb), lambda t, j: (j, 0, 0),
+            pl.BlockSpec((1, H, 3 * hb), lambda b, t, j: (j, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+            pl.BlockSpec((Bc, H), lambda b, t, j: (b, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=tuple(out_specs),
         scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((Bc, H), jnp.float32),
+            pltpu.VMEM((Bc, H), jnp.float32),
         ],
         interpret=interpret,
     )(xgl, Rl, h0)
@@ -205,8 +222,8 @@ def _fused(x, h0, W, R, b, reverse):
 
 def _kernel_bwd_enabled(B, H, rdtype) -> bool:
     return (not env.gru_scan_bwd
-            and gru_bwd_tile(
-                B, H, rdtype_bytes=jnp.dtype(_panel_dtype(rdtype)).itemsize)
+            and gru_bwd_plan(
+                B, H, rdtype_bytes=jnp.dtype(_panel_dtype(rdtype)).itemsize)[1]
             is not None)
 
 
@@ -228,12 +245,13 @@ def _gru_bwd_kernel(r_ref, z_ref, n_ref, hgn_ref, rt_ref, hprev_ref,
       dr = ga_n*hg_n;      ga_r = dr*r*(1-r)
     carry' = z*dh_tot (direct path, per slice)
            + [ga_r, ga_z, r*ga_n] @ R^T (accumulated over slices).
-    The final carry is dh0 — emitted on the last step.
+    The final carry is dh0 — emitted on the last step. Grid (nb, T, nj)
+    with the batch block outermost (r4), as in the forward.
     """
-    t = pl.program_id(0)
-    j = pl.program_id(1)
-    nt = pl.num_programs(0)
-    nj = pl.num_programs(1)
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    nt = pl.num_programs(1)
+    nj = pl.num_programs(2)
 
     @pl.when((t == 0) & (j == 0))
     def _init():
@@ -293,38 +311,41 @@ def _gru_bwd_kernel(r_ref, z_ref, n_ref, hgn_ref, rt_ref, hprev_ref,
         dh0_ref[:] = dhn_scr[:]
 
 
-def _bwd_recurrence(residuals, R, hprev_seq, dout, *, hb, interpret):
+def _bwd_recurrence(residuals, R, hprev_seq, dout, *, plan, interpret):
     """Reverse-time kernel. residuals/hprev_seq/dout in KERNEL time order.
     Returns (ga_r, ga_z, ga_n — each [T, B, H] f32, kernel order — and
-    dh0 [B, H])."""
+    dh0 [B, H]). ``plan`` = (Bc, hb), chosen independently of the
+    forward's (see fused_lstm._bwd_recurrence)."""
     rr, rz, rn, rhgn = residuals
     T, B, H = rr.shape
+    Bc, hb = plan
+    nb = B // Bc
     nj = H // hb
     pdt = _panel_dtype(R.dtype)
     Rt = (R.reshape(H, 3, nj, hb).transpose(2, 1, 3, 0)   # [nj, 3, hb, H]
           .astype(pdt))
 
-    revj = lambda t, j: (T - 1 - t, 0, j)
-    tile = pl.BlockSpec((1, B, hb), revj, memory_space=pltpu.VMEM)
+    revj = lambda b, t, j: (T - 1 - t, b, j)
+    tile = pl.BlockSpec((1, Bc, hb), revj, memory_space=pltpu.VMEM)
 
     return pl.pallas_call(
         functools.partial(_gru_bwd_kernel, hb=hb),
         out_shape=(jax.ShapeDtypeStruct((T, B, H), jnp.float32),) * 3
         + (jax.ShapeDtypeStruct((B, H), jnp.float32),),
-        grid=(T, nj),
+        grid=(nb, T, nj),
         in_specs=[
             tile, tile, tile, tile,                    # r, z, n, hg_n
-            pl.BlockSpec((1, 3, hb, H), lambda t, j: (j, 0, 0, 0),
+            pl.BlockSpec((1, 3, hb, H), lambda b, t, j: (j, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             tile,                                      # h_prev
             tile,                                      # dout
         ],
         out_specs=(tile,) * 3 + (
-            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+            pl.BlockSpec((Bc, H), lambda b, t, j: (b, 0),
                          memory_space=pltpu.VMEM),),
         scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),   # dh carry (stable per t)
-            pltpu.VMEM((B, H), jnp.float32),   # dh accumulator
+            pltpu.VMEM((Bc, H), jnp.float32),  # dh carry (stable per t)
+            pltpu.VMEM((Bc, H), jnp.float32),  # dh accumulator
         ],
         interpret=interpret,
     )(rr, rz, rn, rhgn, Rt, hprev_seq, dout)
@@ -348,7 +369,7 @@ def _fused_bwd(reverse, res, g):
     H = R.shape[0]
     if residuals is None:
         return _scan_bwd(reverse, (x, h0, W, R, b), g)
-    hb = gru_bwd_tile(
+    plan = gru_bwd_plan(
         B, H, rdtype_bytes=jnp.dtype(_panel_dtype(R.dtype)).itemsize)
 
     g_out, g_hT = g
@@ -363,7 +384,7 @@ def _fused_bwd(reverse, res, g):
     hprev_k = jnp.concatenate([h0[None].astype(out_k.dtype), out_k[:-1]], 0)
 
     ga_r, ga_z, ga_n, dh0 = _bwd_recurrence(
-        residuals, R, hprev_k, dout_k, hb=hb, interpret=_interpret())
+        residuals, R, hprev_k, dout_k, plan=plan, interpret=_interpret())
     # hg_n's gradient (for dR's n block and the recurrent path already
     # inside the kernel) is r*ga_n; cheap elementwise, XLA fuses it here
     ga_hn = rr * ga_n
@@ -418,20 +439,21 @@ def fused_gru_layer(x, h0, W, R, b, *, reverse=False):
 def _gru_requires(x, h0, W, R, b, **kw):
     Hp = _pad_to_lanes(R.shape[0])
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
-    return gru_tile(x.shape[0], Hp, rdtype_bytes=rb,
-                    save_residuals=True) is not None
+    return gru_plan(x.shape[0], Hp, rdtype_bytes=rb,
+                    save_residuals=True)[1] is not None
 
 
 def _gru_applicable(x, h0, W, R, b, **kw):
     """Same measured selection policy as the fused LSTM: the kernel wins
-    when ONE hidden tile spans H (R panel fetched once, recurrence fully
-    VMEM-resident); multi-tile shapes re-stream R per step and stay on the
-    XLA scan. Verified by the bench `kernels` mode A/B rows."""
+    when R is grid-invariant (one hidden tile spans H, fetched once, the
+    recurrence fully VMEM-resident) — which r4's batch-blocked grid now
+    achieves at large B too. Verified by the bench `kernels` mode A/B
+    rows."""
     Hp = _pad_to_lanes(R.shape[0])
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
     return (x.shape[0] % 8 == 0
-            and gru_tile(x.shape[0], Hp, rdtype_bytes=rb,
-                         save_residuals=True) == Hp)
+            and gru_plan(x.shape[0], Hp, rdtype_bytes=rb,
+                         save_residuals=True)[1] == Hp)
 
 
 register_impl("gru_layer", platform="pallas", predicate=_gru_applicable,

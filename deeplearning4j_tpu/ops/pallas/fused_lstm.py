@@ -12,18 +12,21 @@ pipelined program instead of T dispatched step-fusions (the reason cuDNN's
 persistent kernels win — per-step launch/fusion overhead is the dominant
 cost of the XLA scan at these shapes, not FLOPs).
 
-Tiling: grid (T, H/Hb), hidden-tile innermost. Each (t, j) step computes
-gate columns for hidden slice j from the FULL previous h (double-buffered
-in scratch: h_prev is stable while h_next accumulates tiles, swapped after
-the last tile of each timestep), so R never needs to fit VMEM whole —
-R is pre-laid-out as [nH, H, 4*Hb] per-tile panels. The tile size is chosen
-by a VMEM budget (lstm_tile). Selection (r3, measured): the kernel is used
-when ONE tile spans H, i.e. the R panel block index is grid-constant so
-Pallas fetches R exactly once and the recurrence never touches HBM —
-measured 1.0-2.0x the scan on v5e. Multi-tile shapes (B=256 with H>=512)
-re-stream R panels every timestep and measured 0.6-0.9x, so they stay on
-the XLA scan (numbers in BASELINE.md) — correctness for nj>1 is still
-fully tested (interpret mode + FORCE_PALLAS).
+Tiling: grid (B/Bc, T, H/Hb) — batch block outermost (r4), hidden tile
+innermost. Each (t, j) step computes gate columns for hidden slice j from
+the FULL previous h (double-buffered in scratch: h_prev is stable while
+h_next accumulates tiles, swapped after the last tile of each timestep),
+so R never needs to fit VMEM whole — R is pre-laid-out as [nH, H, 4*Hb]
+per-tile panels. The (Bc, Hb) plan is chosen by a VMEM budget (lstm_plan):
+one hidden tile spanning H keeps the R panel's block index grid-constant,
+so Pallas fetches R exactly once for the ENTIRE grid — including across
+batch blocks, which is what un-demoted the r3 losing regime (B=256/H=1024
+re-streamed R per step at 0.4-0.9x; batch-blocked it measures 1.10x fwd /
+1.33x train, BASELINE.md r4). The forward and backward choose their batch
+blocks independently (the fwd must stay fully resident and wants the
+largest resident block for MXU row fill; the bwd tolerates nj=2 and
+prefers batch rows — (64, 512) measured faster than the fully-resident
+(32, 1024)); the shared [T, B, H] residual layouts make that free.
 
 Matmul precision: panels are pre-cast to bfloat16 with f32 accumulation —
 the SAME truncation XLA applies to f32 dot operands on TPU under the
@@ -84,10 +87,14 @@ def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, p_ref, out_ref, hT_ref,
         hprev_scr, hnext_scr, c_scr = rest[5:]
     else:
         hprev_scr, hnext_scr, c_scr = rest
-    t = pl.program_id(0)
-    j = pl.program_id(1)
-    nt = pl.num_programs(0)
-    nj = pl.num_programs(1)
+    # grid (nb, T, nj): batch-block OUTERMOST (r4) — each block runs the
+    # whole T recurrence with its own h/c scratch; R's block index ignores
+    # every axis, so when one hidden tile spans H the panel is fetched ONCE
+    # for ALL batch blocks (the batch-tiled persistent-RNN regime)
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    nt = pl.num_programs(1)
+    nj = pl.num_programs(2)
 
     @pl.when((t == 0) & (j == 0))
     def _init():
@@ -138,17 +145,21 @@ def _lstm_kernel(xg_ref, r_ref, h0_ref, c0_ref, p_ref, out_ref, hT_ref,
 
 
 def lstm_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
-    """Largest hidden tile (multiple of 128, dividing H) whose working set
-    fits the VMEM budget; None when even Hb=128 does not fit (fall back).
+    """Largest hidden tile (multiple of 128, dividing H) for a batch block
+    of B rows; None when even Hb=128 does not fit (fall back).
 
     Grid-VARYING blocks (R/xg/peephole panels indexed by t or j, and the
     out/hT/cT[/cseq/gate] tiles) are double-buffered by the Pallas
-    pipeline, so they count twice; the grid-invariant h0/c0 blocks and the
-    three scratch buffers count once. When ONE tile spans H the R panel's
-    block index is grid-constant, so it is fetched once and counts ONCE —
-    that accounting unlocks full-residency at H=1024/small-B, measured
-    1.2-1.5x the scan on-chip (BASELINE.md r3). R panels are bf16 on TPU
-    (rdtype_bytes=2). Budget is set under the ~16M scoped-VMEM limit."""
+    pipeline, so they count twice; grid-invariant blocks and the three
+    scratch buffers count once. When ONE tile spans H the R panel's block
+    index is grid-constant, so it is fetched once and counts ONCE — that
+    accounting unlocks full-residency at H=1024/small-B, measured 1.2-1.5x
+    the scan on-chip (BASELINE.md r3). Blocks whose index varies only on
+    the outermost batch-block axis (h0/c0) still count once: Pallas skips
+    the DMA while the block index is unchanged, so they re-fetch only at
+    chunk boundaries — amortized over T*nj inner iterations. R panels are
+    bf16 on TPU (rdtype_bytes=2). Budget is set under the ~16M scoped-VMEM
+    limit."""
     for hb in (H, 1024, 512, 256, 128):
         if hb > H or H % hb:
             continue
@@ -157,7 +168,7 @@ def lstm_tile(B, H, rdtype_bytes=2, budget=13 << 20, save_residuals=False):
                + 2 * B * 4 * hb * 4            # xg block (dbl-buffered)
                + 2 * 3 * B * hb * 4            # out/hT/cT tiles (dbl)
                + 3 * B * H * 4                 # h double buffer + c scratch
-               + 2 * B * H * 4)                # h0 + c0 (invariant)
+               + 2 * B * H * 4)                # h0 + c0 (refetch amortized)
         if save_residuals:
             est += 2 * 5 * B * hb * 4          # cseq + 4 gate tiles (dbl)
         if est <= budget:
@@ -179,11 +190,61 @@ def lstm_bwd_tile(B, H, rdtype_bytes=2, budget=13 << 20):
                + 3 * 2 * B * hb * 4            # c_prev/c/dout tiles (dbl)
                + 2 * 4 * B * hb * 4            # dg out tiles (dbl)
                + 2 * B * hb * 4                # dc0 out tile (dbl)
-               + B * H * 4                     # dcT (invariant)
+               + B * H * 4                     # dcT (refetch amortized)
                + 3 * B * H * 4)                # dh carry + dh accum + dc
         if est <= budget:
             return hb
     return None
+
+
+def _plan(tile_fn, B, H, **kw):
+    """(Bc, hb) for the FORWARD: batch-block size and hidden tile.
+
+    The forward must keep R grid-invariant (hb == H): per step it runs ONE
+    dot against the full R, so any panel re-streaming is exposed —
+    measured 0.33-0.60x at B=256/H=1024 for every nj > 1 or
+    under-resident plan. When the full batch cannot be resident, split it
+    into batch blocks (r4) and take the LARGEST resident block (MXU row
+    fill: Bc=64 measured 1.10x fwd where Bc=32 measured 0.60x). Falls
+    back to hidden tiling at full B (reachable via FORCE_PALLAS only) and
+    (None, None) when nothing fits."""
+    hb = tile_fn(B, H, **kw)
+    if hb == H:
+        return B, hb
+    for Bc in (128, 64, 32):
+        if B % Bc == 0 and Bc < B and tile_fn(Bc, H, **kw) == H:
+            return Bc, H
+    return (B, hb) if hb else (None, None)
+
+
+def _bwd_plan(tile_fn, B, H, **kw):
+    """(Bc, hb) for the BACKWARD: unlike the forward, nj == 2 is fine —
+    each reverse step runs FOUR dots against the R^T panels (one per
+    gate), so the alternating-panel traffic hides under compute. Measured
+    at B=256/H=1024: (64, 512) runs the bwd in ~1.4 ms where the fully-
+    resident (32, 1024) takes ~2.6 ms — batch rows beat residency. Rank:
+    largest batch block whose tile keeps nj <= 2."""
+    fallback = None
+    for Bc in (B, 128, 64, 32):
+        if Bc > B or B % Bc:
+            continue
+        hb = tile_fn(Bc, H, **kw)
+        if hb is None:
+            continue
+        if 2 * hb >= H:
+            return Bc, hb
+        if fallback is None:
+            fallback = (Bc, hb)
+    return fallback or (None, None)
+
+
+def lstm_plan(B, H, rdtype_bytes=2, save_residuals=False):
+    return _plan(lstm_tile, B, H, rdtype_bytes=rdtype_bytes,
+                 save_residuals=save_residuals)
+
+
+def lstm_bwd_plan(B, H, rdtype_bytes=2):
+    return _bwd_plan(lstm_bwd_tile, B, H, rdtype_bytes=rdtype_bytes)
 
 
 def _fused_recurrence(xg, R, h0, c0, peephole, *, interpret,
@@ -195,10 +256,11 @@ def _fused_recurrence(xg, R, h0, c0, peephole, *, interpret,
     T, B, G = xg.shape
     H = G // 4
     pdt = _panel_dtype(R.dtype)
-    hb = lstm_tile(B, H, rdtype_bytes=jnp.dtype(pdt).itemsize,
-                   save_residuals=save_residuals)
+    Bc, hb = lstm_plan(B, H, rdtype_bytes=jnp.dtype(pdt).itemsize,
+                       save_residuals=save_residuals)
     if hb is None:
         raise ValueError(f"no VMEM-feasible LSTM tile for B={B}, H={H}")
+    nb = B // Bc
     nj = H // hb
     # per-tile panels: R [nH, H, 4*Hb]; xg [T, nH, B, 4*Hb]
     Rl = (R.reshape(H, 4, nj, hb).transpose(2, 0, 1, 3)
@@ -211,15 +273,17 @@ def _fused_recurrence(xg, R, h0, c0, peephole, *, interpret,
     else:
         pll = jnp.zeros((nj, 3, hb), xg.dtype)
 
-    tile_tj = pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
+    tile_tj = pl.BlockSpec((1, Bc, hb), lambda b, t, j: (t, b, j),
                            memory_space=pltpu.VMEM)
     out_shape = [jax.ShapeDtypeStruct((T, B, H), xg.dtype),
                  jax.ShapeDtypeStruct((B, H), xg.dtype),
                  jax.ShapeDtypeStruct((B, H), xg.dtype)]
     out_specs = [
         tile_tj,
-        pl.BlockSpec((B, hb), lambda t, j: (0, j), memory_space=pltpu.VMEM),
-        pl.BlockSpec((B, hb), lambda t, j: (0, j), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Bc, hb), lambda b, t, j: (b, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((Bc, hb), lambda b, t, j: (b, j),
+                     memory_space=pltpu.VMEM),
     ]
     if save_residuals:
         for _ in range(5):                     # cseq + 4 post-activation gates
@@ -230,24 +294,24 @@ def _fused_recurrence(xg, R, h0, c0, peephole, *, interpret,
         functools.partial(_lstm_kernel, hb=hb, has_peephole=has_p,
                           save_residuals=save_residuals),
         out_shape=tuple(out_shape),
-        grid=(T, nj),
+        grid=(nb, T, nj),
         in_specs=[
-            pl.BlockSpec((1, 1, B, 4 * hb), lambda t, j: (t, j, 0, 0),
+            pl.BlockSpec((1, 1, Bc, 4 * hb), lambda b, t, j: (t, j, b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, H, 4 * hb), lambda t, j: (j, 0, 0),
+            pl.BlockSpec((1, H, 4 * hb), lambda b, t, j: (j, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+            pl.BlockSpec((Bc, H), lambda b, t, j: (b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+            pl.BlockSpec((Bc, H), lambda b, t, j: (b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3, hb), lambda t, j: (j, 0, 0),
+            pl.BlockSpec((1, 3, hb), lambda b, t, j: (j, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=tuple(out_specs),
         scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((B, H), jnp.float32),
-            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((Bc, H), jnp.float32),
+            pltpu.VMEM((Bc, H), jnp.float32),
+            pltpu.VMEM((Bc, H), jnp.float32),
         ],
         interpret=interpret,
     )(xgl, Rl, h0, c0, pll)
@@ -295,8 +359,8 @@ def _kernel_bwd_enabled(B, H, rdtype) -> bool:
     consume) the reserve space only when the backward kernel will run, so
     the scan-backward arm (flag or infeasible tile) pays no reserve cost."""
     return (not env.lstm_scan_bwd
-            and lstm_bwd_tile(
-                B, H, rdtype_bytes=jnp.dtype(_panel_dtype(rdtype)).itemsize)
+            and lstm_bwd_plan(
+                B, H, rdtype_bytes=jnp.dtype(_panel_dtype(rdtype)).itemsize)[1]
             is not None)
 
 
@@ -326,11 +390,14 @@ def _lstm_bwd_kernel(i_ref, f_ref, o_ref, z_ref, rt_ref, cprev_ref, c_ref,
     carries: dh_rec (accumulated over j via dg_j @ R_j^T against the
     pre-transposed panel) and dc (per-slice, in place). Time reversal is
     done by the BlockSpec index maps, not by flipping arrays in HBM.
+    Grid (nb, T, nj) with the batch block outermost (r4), mirroring the
+    forward: each batch block replays the reverse recurrence with its own
+    carries while the R^T panel stays grid-invariant.
     """
-    t = pl.program_id(0)
-    j = pl.program_id(1)
-    nt = pl.num_programs(0)
-    nj = pl.num_programs(1)
+    t = pl.program_id(1)
+    j = pl.program_id(2)
+    nt = pl.num_programs(1)
+    nj = pl.num_programs(2)
 
     @pl.when((t == 0) & (j == 0))
     def _init():
@@ -394,12 +461,19 @@ def _lstm_bwd_kernel(i_ref, f_ref, o_ref, z_ref, rt_ref, cprev_ref, c_ref,
 
 
 def _bwd_recurrence(residuals, R, cprev_seq, dout, dcT, peephole, *,
-                    hb, interpret):
+                    plan, interpret):
     """Run the reverse-time kernel. ``residuals`` = (cseq, i, f, o, z) from
     the forward, KERNEL time order. Returns (dgi, dgf, dgo, dgz — each
-    [T, B, H] f32 in kernel time order — and dc0)."""
+    [T, B, H] f32 in kernel time order — and dc0). ``plan`` = (Bc, hb):
+    the backward's batch block is chosen independently of the forward's
+    (measured at B=256/H=1024: the bwd's best plan is (64, 512) — nj=2
+    with more batch rows beats the fully-resident (32, 1024), ~1.4 ms vs
+    ~2.6 ms — while the fwd must stay resident; the shared [T, B, H]
+    layouts make the re-chunk free)."""
     cseq, gi, gf, go, gz = residuals
     T, B, H = cseq.shape
+    Bc, hb = plan
+    nb = B // Bc
     nj = H // hb
     pdt = _panel_dtype(R.dtype)
     # pre-transposed panels: Rt[j, g] = R[:, g*H + j*hb : ...]^T  [hb, H]
@@ -411,33 +485,33 @@ def _bwd_recurrence(residuals, R, cprev_seq, dout, dcT, peephole, *,
     else:
         pll = jnp.zeros((nj, 3, hb), R.dtype)
 
-    revj = lambda t, j: (T - 1 - t, 0, j)          # reverse-time j-tiles
-    tile = pl.BlockSpec((1, B, hb), revj, memory_space=pltpu.VMEM)
+    revj = lambda b, t, j: (T - 1 - t, b, j)       # reverse-time j-tiles
+    tile = pl.BlockSpec((1, Bc, hb), revj, memory_space=pltpu.VMEM)
 
     out = pl.pallas_call(
         functools.partial(_lstm_bwd_kernel, hb=hb, has_peephole=has_p),
         out_shape=(jax.ShapeDtypeStruct((T, B, H), jnp.float32),) * 4
         + (jax.ShapeDtypeStruct((B, H), jnp.float32),),
-        grid=(T, nj),
+        grid=(nb, T, nj),
         in_specs=[
             tile, tile, tile, tile,                    # i, f, o, z
-            pl.BlockSpec((1, 4, hb, H), lambda t, j: (j, 0, 0, 0),
+            pl.BlockSpec((1, 4, hb, H), lambda b, t, j: (j, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             tile,                                      # c_prev
             tile,                                      # c
             tile,                                      # dout
-            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+            pl.BlockSpec((Bc, H), lambda b, t, j: (b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3, hb), lambda t, j: (j, 0, 0),
+            pl.BlockSpec((1, 3, hb), lambda b, t, j: (j, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(tile,) * 4 + (
-            pl.BlockSpec((B, hb), lambda t, j: (0, j),
+            pl.BlockSpec((Bc, hb), lambda b, t, j: (b, j),
                          memory_space=pltpu.VMEM),),
         scratch_shapes=[
-            pltpu.VMEM((B, H), jnp.float32),   # dh_rec carry (stable per t)
-            pltpu.VMEM((B, H), jnp.float32),   # dh_rec accumulator
-            pltpu.VMEM((B, H), jnp.float32),   # dc carry (per-slice in place)
+            pltpu.VMEM((Bc, H), jnp.float32),  # dh_rec carry (stable per t)
+            pltpu.VMEM((Bc, H), jnp.float32),  # dh_rec accumulator
+            pltpu.VMEM((Bc, H), jnp.float32),  # dc carry (per-slice in place)
         ],
         interpret=interpret,
     )(gi, gf, go, gz, Rt, cprev_seq, cseq, dout, dcT, pll)
@@ -476,7 +550,7 @@ def _fused_bwd(forget_gate_bias, reverse, res, g):
     if residuals is None:   # forward already decided: scan backward
         return _scan_bwd(forget_gate_bias, reverse,
                          (x, h0, c0, W, R, b, peephole), g)
-    hb = lstm_bwd_tile(
+    plan = lstm_bwd_plan(
         B, H, rdtype_bytes=jnp.dtype(_panel_dtype(R.dtype)).itemsize)
 
     g_out, (g_hT, g_cT) = g
@@ -494,7 +568,7 @@ def _fused_bwd(forget_gate_bias, reverse, res, g):
     cprev_k = jnp.concatenate([c0[None].astype(cseq.dtype), cseq[:-1]], 0)
 
     dgi, dgf, dgo, dgz, dc0 = _bwd_recurrence(
-        residuals, R, cprev_k, dout_k, g_cT, peephole, hb=hb,
+        residuals, R, cprev_k, dout_k, g_cT, peephole, plan=plan,
         interpret=_interpret())
     dgs = (dgi, dgf, dgo, dgz)
 
@@ -587,29 +661,31 @@ def fused_lstm_layer(x, h0, c0, W, R, b, *, peephole=None,
 
 
 def _lstm_requires(x, h0, c0, W, R, b, *, peephole=None, **kw):
-    # structural: a VMEM-feasible tile must exist (incl. reserve outputs),
+    # structural: a VMEM-feasible plan must exist (incl. reserve outputs),
     # sized with the SAME panel dtype _fused_recurrence will actually use
     # (f32 in interpret mode, bf16 on TPU) and the PADDED hidden size the
     # kernel will actually run
     Hp = _pad_to_lanes(R.shape[0])
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
-    return lstm_tile(x.shape[0], Hp, rdtype_bytes=rb,
-                     save_residuals=True) is not None
+    return lstm_plan(x.shape[0], Hp, rdtype_bytes=rb,
+                     save_residuals=True)[1] is not None
 
 
 def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
-    """Perf heuristic (measured on v5e, r3): the kernel wins when ONE hidden
-    tile spans H — the R panel then has a constant block index, Pallas
-    fetches it once, and the whole recurrence runs out of VMEM (fwd up to
-    2.0x, train 1.1-1.2x vs the scan at B=64-128, H<=512). With nj>1 the R
-    panels re-stream from HBM every timestep and the scan lowering wins
-    (0.6-0.9x measured at B=256, H=512/1024) — those shapes stay on XLA,
-    numbers in BASELINE.md."""
+    """Perf heuristic (measured on v5e, r3+r4): the kernel wins when R is
+    grid-invariant — ONE hidden tile spans H, fetched once, the recurrence
+    runs out of VMEM (fwd up to 2.0x, train 1.1-1.6x vs the scan). r4
+    extends that regime to LARGE batches by batch-blocking the grid: at
+    B=256/H=1024 (the r3 demoted shape) the fwd runs resident batch
+    blocks (Bc=64 infer / Bc=32 train) and the bwd runs (64, 512),
+    measured 1.10x fwd / 1.33x train — numbers in BASELINE.md. Only
+    shapes with no resident plan at all (H too big for any block to keep
+    R in VMEM, e.g. H >= 2048) stay on the XLA scan."""
     Hp = _pad_to_lanes(R.shape[0])         # unaligned H runs zero-padded
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
     return (x.shape[0] % 8 == 0
-            and lstm_tile(x.shape[0], Hp, rdtype_bytes=rb,
-                          save_residuals=True) == Hp)
+            and lstm_plan(x.shape[0], Hp, rdtype_bytes=rb,
+                          save_residuals=True)[1] == Hp)
 
 
 register_impl("lstm_layer", platform="pallas", predicate=_lstm_applicable,

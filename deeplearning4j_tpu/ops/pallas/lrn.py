@@ -53,7 +53,7 @@ def _lrn_forward(x, *, depth, alpha, beta, k, block_rows, interpret):
     C = orig_shape[-1]
     xf = x.reshape(-1, C)
     R = xf.shape[0]
-    br = min(block_rows, R)
+    br = min(_lrn_rows(C, 2, block_rows), R)
     band = _band(C, depth)
     out = pl.pallas_call(
         functools.partial(_lrn_kernel, alpha=alpha, beta=beta, k=k),
@@ -97,13 +97,25 @@ def _lrn_bwd_kernel(x_ref, g_ref, band_ref, dx_ref, *, alpha, beta, k):
     dx_ref[...] = (g * dpow - 2.0 * alpha * beta * x * t).astype(dx_ref.dtype)
 
 
+def _lrn_rows(C, n_blocks, block_rows=512, budget=13 << 20):
+    """Largest row block whose working set fits the VMEM budget:
+    ``n_blocks`` double-buffered [br, C] f32 blocks (fwd: x + out = 2;
+    bwd: x + g + dx = 3) plus the grid-invariant [C, C] band. At C=1024
+    the bwd's three blocks at br=512 would hit ~16.8 MB — over the ~16M
+    scoped limit — so the bwd steps down to br=256 there."""
+    br = block_rows
+    while br > 8 and 2 * n_blocks * br * C * 4 + C * C * 4 > budget:
+        br //= 2
+    return br
+
+
 def _lrn_backward(x, g, *, depth, alpha, beta, k, block_rows, interpret):
     orig_shape = x.shape
     C = orig_shape[-1]
     xf = x.reshape(-1, C)
     gf = g.reshape(-1, C)
     R = xf.shape[0]
-    br = min(block_rows, R)
+    br = min(_lrn_rows(C, 3, block_rows), R)
     band = _band(C, depth)
     dx = pl.pallas_call(
         functools.partial(_lrn_bwd_kernel, alpha=alpha, beta=beta, k=k),

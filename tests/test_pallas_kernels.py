@@ -256,6 +256,84 @@ class TestFusedLSTMTiled:
         # absurd size: no tile fits -> requires() rejects, scan fallback
         assert lstm_tile(8192, 8192) is None
 
+    def test_batch_block_plans(self):
+        """r4: the planner keeps R grid-invariant at large batches by batch-
+        blocking (the bf16-panel sizes the TPU bench runs use)."""
+        from deeplearning4j_tpu.ops.pallas.fused_lstm import (lstm_bwd_plan,
+                                                              lstm_plan)
+
+        # the r3 demoted shape: fwd chunks the batch, keeps hb == H
+        assert lstm_plan(256, 1024) == (64, 1024)
+        assert lstm_plan(256, 1024, save_residuals=True) == (32, 1024)
+        # bwd tolerates nj == 2 and prefers batch rows (measured, r4)
+        assert lstm_bwd_plan(256, 1024) == (64, 512)
+        # small-batch selected regimes are unchanged from r3
+        assert lstm_plan(32, 1024, save_residuals=True) == (32, 1024)
+        assert lstm_plan(64, 256, save_residuals=True) == (64, 256)
+
+
+class TestBatchBlockedRecurrence:
+    """r4: grid (nb, T, nj) — batch-blocked recurrence parity, forced
+    chunked plans (nb > 1) so interpret mode exercises the new grid axis
+    for both forward and backward, with DIFFERENT fwd/bwd chunk sizes (the
+    shipping configuration at B=256/H=1024)."""
+
+    def test_lstm_chunked_parity(self, rng, monkeypatch):
+        import deeplearning4j_tpu.ops.pallas.fused_lstm as fl
+
+        B, T, F, H = 64, 12, 16, 128
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.zeros((B, H))
+        c0 = jnp.zeros((B, H))
+        W = jnp.asarray(rng.normal(size=(F, 4 * H)).astype(np.float32) * .1)
+        R = jnp.asarray(rng.normal(size=(H, 4 * H)).astype(np.float32) * .1)
+        b = jnp.asarray(rng.normal(size=(4 * H,)).astype(np.float32) * .1)
+        p = jnp.asarray(rng.normal(size=(3 * H,)).astype(np.float32) * .1)
+        monkeypatch.setattr(fl, "lstm_plan", lambda BB, HH, **kw: (16, HH))
+        monkeypatch.setattr(fl, "lstm_bwd_plan",
+                            lambda BB, HH, **kw: (32, HH))
+        of, (hf, cf) = fl.fused_lstm_layer(x, h0, c0, W, R, b, peephole=p)
+        orr, (hr, cr) = lstm_layer(x, h0, c0, W, R, b, peephole=p)
+        np.testing.assert_allclose(np.asarray(of), np.asarray(orr),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(cf), np.asarray(cr),
+                                   rtol=2e-4, atol=2e-5)
+        gk = jax.grad(lambda a: fl.fused_lstm_layer(
+            a[0], h0, c0, a[1], a[2], b, peephole=p)[0].sum())((x, W, R))
+        gs = jax.grad(lambda a: lstm_layer(
+            a[0], h0, c0, a[1], a[2], b, peephole=p)[0].sum())((x, W, R))
+        for name, a, b_ in zip(("x", "W", "R"), gk, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} chunked")
+
+    def test_gru_chunked_parity(self, rng, monkeypatch):
+        import deeplearning4j_tpu.ops.pallas.fused_gru as fg
+        from deeplearning4j_tpu.ops.recurrent import gru_layer
+
+        B, T, F, H = 64, 12, 16, 128
+        x = jnp.asarray(rng.normal(size=(B, T, F)).astype(np.float32))
+        h0 = jnp.zeros((B, H))
+        W = jnp.asarray(rng.normal(size=(F, 3 * H)).astype(np.float32) * .1)
+        R = jnp.asarray(rng.normal(size=(H, 3 * H)).astype(np.float32) * .1)
+        b = jnp.asarray(rng.normal(size=(3 * H,)).astype(np.float32) * .1)
+        monkeypatch.setattr(fg, "gru_plan", lambda BB, HH, **kw: (16, HH))
+        monkeypatch.setattr(fg, "gru_bwd_plan", lambda BB, HH, **kw: (32, HH))
+        og, hg = fg.fused_gru_layer(x, h0, W, R, b)
+        osr, hsr = gru_layer(x, h0, W, R, b)
+        np.testing.assert_allclose(np.asarray(og), np.asarray(osr),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(hg), np.asarray(hsr),
+                                   rtol=2e-4, atol=2e-5)
+        gk = jax.grad(lambda a: fg.fused_gru_layer(
+            a[0], h0, a[1], a[2], b)[0].sum())((x, W, R))
+        gs = jax.grad(lambda a: gru_layer(
+            a[0], h0, a[1], a[2], b)[0].sum())((x, W, R))
+        for name, a, b_ in zip(("x", "W", "R"), gk, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} chunked")
+
 
 class TestPallasLRN:
     def test_matches_xla_lowering(self, rng):
