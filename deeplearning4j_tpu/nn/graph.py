@@ -225,24 +225,26 @@ class ComputationGraph:
         self._rnn_carries = None
 
     def as_loss_fn(self, train: bool = False):
-        """(loss_fn(params, x, y) -> scalar, initial params) — the
-        functional surface the parallel trainers consume (the
-        ComputationGraph counterpart of MultiLayerNetwork.as_loss_fn).
+        """(loss_fn(params, state, rng, x, y) -> (loss, new_state),
+        (initial params, initial state)) — the functional surface the
+        parallel trainers consume (the ComputationGraph counterpart of
+        MultiLayerNetwork.as_loss_fn).
 
         x: one array for single-input graphs or a {input_name: array}
-        dict; y likewise for the graph's outputs. Network state is FROZEN
-        at export time and regularization terms are NOT included — the
-        Spark facade rejects configs where that would change semantics."""
-        state = self.state
+        dict; y likewise for the graph's outputs. r4: network state (BN
+        running stats) and the dropout rng are threaded through instead
+        of frozen at export time, and l1/l2 regularization terms are
+        included — matching the fit path."""
         conf = self.conf
 
-        def loss_fn(params, x, y):
+        def loss_fn(params, state, rng, x, y):
+            from deeplearning4j_tpu.nn.conf.graph import LayerVertex
+
             inputs = self._as_input_dict(x)
             labels = y if isinstance(y, dict) else \
                 {conf.network_outputs[0]: y}
-            acts, _, preouts, _ = self._forward(params, state, inputs,
-                                                train, None,
-                                                want_preout=True)
+            acts, new_state, preouts, _ = self._forward(
+                params, state, inputs, train, rng, want_preout=True)
             loss = 0.0
             for name in conf.network_outputs:
                 v = conf.vertices[name]
@@ -253,9 +255,15 @@ class ComputationGraph:
                 else:
                     d = acts[name] - labels[name]
                     loss = loss + (d * d).mean()
-            return loss
+            for name, v in conf.vertices.items():
+                if isinstance(v, LayerVertex) and name in params:
+                    loss = loss + v.layer.regularization(params[name])
+            # vertices with no state entry keep their old (empty) state so
+            # the returned tree matches the input's structure
+            merged = {k: new_state.get(k, s) for k, s in state.items()}
+            return loss, merged
 
-        return loss_fn, self.params
+        return loss_fn, (self.params, self.state)
 
     # ------------------------------------------------------------------- fit
     def _loss(self, params, state, inputs, labels: dict, rng, masks):

@@ -477,26 +477,30 @@ class MultiLayerNetwork:
         return float(loss)
 
     def as_loss_fn(self, train: bool = False):
-        """(loss_fn(params, x, y) -> scalar, initial params) — the
-        functional surface the parallel trainers consume
-        (ParameterAveragingTrainer / EncodedGradientTrainer take a loss
-        over a params TREE, not a model object).
+        """(loss_fn(params, state, rng, x, y) -> (loss, new_state),
+        (initial params, initial state)) — the functional surface the
+        parallel trainers consume (ParameterAveragingTrainer /
+        EncodedGradientTrainer take a loss over plain TREES, not a model
+        object).
 
-        Network state (BN running stats, RNN carries) is FROZEN at export
-        time: the functional trainers carry parameters only, exactly like
-        the reference's parameter server exchanged `params()` and not
-        updater-internal state. train=True runs train-mode forward (batch
-        statistics in BN) without a dropout key; leave False for nets with
-        dropout."""
-        state = self.state
+        r4: network state (BN running stats) and the dropout rng are
+        THREADED through the surface instead of frozen at export time, so
+        the functional trainers can train BN/dropout models — the
+        reference's ParameterAveragingTrainingMaster averages any model,
+        running stats included. l1/l2 regularization terms are included,
+        matching the fit path. train=True runs train-mode forward (batch
+        statistics in BN, dropout when ``rng`` is not None); rng=None
+        disables dropout."""
         layers = self.layers
 
-        def loss_fn(params, x, y):
-            preout, _, out_mask, _ = self._forward(params, state, x, train,
-                                                   None, None)
-            return layers[-1].score_from_preout(y, preout, out_mask).mean()
+        def loss_fn(params, state, rng, x, y):
+            preout, new_states, out_mask, _ = self._forward(
+                params, state, x, train, rng, None)
+            per = layers[-1].score_from_preout(y, preout, out_mask)
+            reg = sum(l.regularization(p) for l, p in zip(layers, params))
+            return per.mean() + reg, new_states
 
-        return loss_fn, self.params
+        return loss_fn, (self.params, self.state)
 
     # ----------------------------------------------------------------- score
     def score(self, ds=None) -> float:

@@ -37,11 +37,22 @@ class ParameterAveragingTrainer:
     any framework updater (stateful ones are fine: the state lives
     per-replica and is averaged with the params, the reference's
     averageUpdaterState behavior).
+
+    ``stateful=True`` (r4) switches the functional contract to
+    loss_fn(params, state, rng, x, y) -> (loss, new_state) — the
+    MultiLayerNetwork/ComputationGraph ``as_loss_fn`` surface — so models
+    with BatchNorm running stats and dropout train on this path: network
+    state is carried per-replica across the K local steps, float state
+    leaves (running stats) are AVERAGED at sync like the reference master
+    averages them with the params, and each local step draws a distinct
+    per-replica dropout key (deterministically folded from the round key,
+    the step counter, and the replica index, so the round stays one
+    replicated SPMD program).
     """
 
     def __init__(self, loss_fn: Callable, updater, mesh, *,
                  axis: str = "data", averaging_frequency: int = 1,
-                 average_updater_state: bool = True):
+                 average_updater_state: bool = True, stateful: bool = False):
         from deeplearning4j_tpu.optimize.updaters import get_updater
 
         self.loss_fn = loss_fn
@@ -53,47 +64,90 @@ class ParameterAveragingTrainer:
                              f"{averaging_frequency}")
         self.freq = int(averaging_frequency)
         self.average_updater_state = average_updater_state
+        self.stateful = stateful
         self._round = None
 
-    def init(self, params):
+    def init(self, params, state=None, rng=None):
         n = self.mesh.shape[self.axis]
-        rep = jax.tree_util.tree_map(
-            lambda p: jnp.broadcast_to(p[None], (n,) + p.shape), params)
+
+        def rep(tree):
+            return jax.tree_util.tree_map(
+                lambda p: jnp.broadcast_to(p[None], (n,) + p.shape), tree)
+
         opt = self.updater.init_state(params)
-        opt_rep = jax.tree_util.tree_map(
-            lambda s: jnp.broadcast_to(s[None], (n,) + s.shape), opt)
         self._round = None  # re-init invalidates the cached compiled round
-        return {"params": rep, "opt": opt_rep, "step": jnp.asarray(0, jnp.int32)}
+        carry = {"params": rep(params), "opt": rep(opt),
+                 "step": jnp.asarray(0, jnp.int32)}
+        if self.stateful:
+            carry["state"] = rep(state if state is not None else {})
+            key = rng if rng is not None else jax.random.key(0)
+            carry["rng"] = jax.random.key_data(key)
+        return carry
 
     def _build(self, carry):
         loss_fn, updater = self.loss_fn, self.updater
         axis = self.axis
         avg_opt = self.average_updater_state
+        stateful = self.stateful
+
+        def avg_state_leaf(t):
+            # running stats (floats) are averaged at sync, like the
+            # reference's parameter averaging of the full param vector;
+            # integer leaves (counters) advance identically per replica
+            # and pass through
+            if jnp.issubdtype(t.dtype, jnp.floating):
+                return lax.pmean(t, axis)
+            return t
 
         def round_fn(carry, xs, ys):
             """One averaging round: K purely-local steps, then ONE pmean.
             xs/ys: [K, local_batch, ...] — K microbatches for this replica."""
             params = jax.tree_util.tree_map(lambda t: t[0], carry["params"])
             opt = jax.tree_util.tree_map(lambda t: t[0], carry["opt"])
+            if stateful:
+                net_state0 = jax.tree_util.tree_map(lambda t: t[0],
+                                                    carry["state"])
+                round_key = jax.random.wrap_key_data(carry["rng"])
 
             def local_step(state, batch):
-                p, o, i = state
                 x, y = batch
-                loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+                if stateful:
+                    p, o, s, i = state
+                    k = jax.random.fold_in(
+                        jax.random.fold_in(round_key, i),
+                        lax.axis_index(axis))
+                    (loss, s2), g = jax.value_and_grad(
+                        loss_fn, has_aux=True)(p, s, k, x, y)
+                else:
+                    p, o, i = state
+                    loss, g = jax.value_and_grad(loss_fn)(p, x, y)
                 upd, o2 = updater.update(g, o, p, i)
                 p2 = jax.tree_util.tree_map(lambda a, d: a - d, p, upd)
+                if stateful:
+                    return (p2, o2, s2, i + 1), loss
                 return (p2, o2, i + 1), loss
 
-            (params, opt, step), losses = lax.scan(
-                local_step, (params, opt, carry["step"]), (xs, ys))
+            if stateful:
+                (params, opt, net_state, step), losses = lax.scan(
+                    local_step, (params, opt, net_state0, carry["step"]),
+                    (xs, ys))
+            else:
+                (params, opt, step), losses = lax.scan(
+                    local_step, (params, opt, carry["step"]), (xs, ys))
             # the round's single collective: average the diverged replicas
             params = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), params)
             if avg_opt:
                 opt = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), opt)
-            return ({"params": jax.tree_util.tree_map(lambda t: t[None], params),
-                     "opt": jax.tree_util.tree_map(lambda t: t[None], opt),
-                     "step": step},
-                    lax.pmean(losses.mean(), axis))
+            out = {"params": jax.tree_util.tree_map(lambda t: t[None], params),
+                   "opt": jax.tree_util.tree_map(lambda t: t[None], opt),
+                   "step": step}
+            if stateful:
+                net_state = jax.tree_util.tree_map(avg_state_leaf, net_state)
+                out["state"] = jax.tree_util.tree_map(lambda t: t[None],
+                                                      net_state)
+                out["rng"] = jax.random.key_data(
+                    jax.random.fold_in(round_key, step))
+            return out, lax.pmean(losses.mean(), axis)
 
         spec_rep = {
             "params": jax.tree_util.tree_map(lambda _: P(axis),
@@ -101,6 +155,10 @@ class ParameterAveragingTrainer:
             "opt": jax.tree_util.tree_map(lambda _: P(axis), carry["opt"]),
             "step": P(),
         }
+        if stateful:
+            spec_rep["state"] = jax.tree_util.tree_map(lambda _: P(axis),
+                                                       carry["state"])
+            spec_rep["rng"] = P()
         fn = shard_map(
             round_fn, mesh=self.mesh,
             in_specs=(spec_rep, P(None, axis), P(None, axis)),
@@ -132,3 +190,12 @@ class ParameterAveragingTrainer:
     def params(self, carry):
         """The (replica-identical) averaged params as a plain tree."""
         return jax.tree_util.tree_map(lambda t: t[0], carry["params"])
+
+    def state(self, carry):
+        """The network state tree after the last sync (stateful mode):
+        float leaves are replica-identical post-average; integer leaves are
+        taken from replica 0 (identical by construction — every replica
+        runs the same step count)."""
+        if not self.stateful:
+            raise ValueError("state() requires stateful=True")
+        return jax.tree_util.tree_map(lambda t: t[0], carry["state"])

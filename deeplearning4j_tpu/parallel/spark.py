@@ -116,11 +116,14 @@ class SparkDl4jMultiLayer:
         )
 
         self._check_local_sgd_supported(K)
-        loss_fn, params0 = self.network.as_loss_fn()
+        # r4: the stateful functional surface — BN running stats and the
+        # dropout rng thread through, so those configs train here now
+        loss_fn, (params0, state0) = self.network.as_loss_fn(train=True)
         trainer = ParameterAveragingTrainer(
             loss_fn, self.network.conf.updater, self._wrapper.mesh.mesh,
-            averaging_frequency=K)
-        carry = trainer.init(params0)
+            averaging_frequency=K, stateful=True)
+        carry = trainer.init(params0, state=state0,
+                             rng=self.network._next_key())
         # one averaging round consumes K global batches; the accumulator
         # carries ACROSS epoch boundaries (a small dataset may hold fewer
         # than K batches per epoch — rounds must still complete, exactly
@@ -159,11 +162,13 @@ class SparkDl4jMultiLayer:
                 f"not fill an averaging round of {K} and {dropped_tail} "
                 f"tail example(s) that did not fill a global batch; size "
                 f"the dataset/epochs accordingly for full coverage")
-        # averaged parameters flow back into the model (the reference's
-        # post-fit network state: the master serializes PARAMS; updater
-        # moments restart fresh, so re-init the model's own opt state to
-        # match the new params rather than leaving stale moments)
+        # averaged parameters AND network state (BN running stats, r4)
+        # flow back into the model (the reference's post-fit network
+        # state: the master serializes PARAMS; updater moments restart
+        # fresh, so re-init the model's own opt state to match the new
+        # params rather than leaving stale moments)
         self.network.params = trainer.params(carry)
+        self.network.state = trainer.state(carry)
         ups = self.network._updaters
         if isinstance(self.network.params, dict):   # ComputationGraph
             self.network.opt_state = {
@@ -176,11 +181,13 @@ class SparkDl4jMultiLayer:
 
     def _check_local_sgd_supported(self, K):
         """The K>1 path optimizes the model through its FUNCTIONAL loss
-        (as_loss_fn): params-only, global updater, inference-mode forward.
-        Configs whose training semantics that would silently change are
-        rejected loudly — the reference behavior for them is
-        averaging_frequency=1 (exact) or the standalone
-        ParameterAveragingTrainer with a custom loss."""
+        (as_loss_fn). r4: that surface threads (state, rng) and includes
+        l1/l2 terms, so BatchNorm, dropout and regularization train here
+        now — the reference master averages any model. What remains
+        rejected is what the single-global-updater trainer genuinely
+        cannot express: per-layer updater overrides, frozen layers,
+        gradient clipping, center loss, and multi-input/-output graphs
+        (the round batch plumbing carries one features/labels pair)."""
         net = self.network
         conf = net.conf
         problems = []
@@ -191,26 +198,16 @@ class SparkDl4jMultiLayer:
         else:                                # ComputationGraph
             from deeplearning4j_tpu.nn.conf.graph import LayerVertex
 
-            # the round batch plumbing carries ONE features array and ONE
-            # labels array; multi-input/-output graphs need the dict-fed
-            # standalone trainer instead
             if len(conf.network_inputs) != 1 or \
                     len(conf.network_outputs) != 1:
                 problems.append("multiple graph inputs/outputs")
             named = [(n, v.layer) for n, v in conf.vertices.items()
                      if isinstance(v, LayerVertex)]
         for i, l in named:
-            if getattr(l, "dropout", 0.0):
-                problems.append(f"layer {i} dropout")
-            if getattr(l, "l1", 0.0) or getattr(l, "l2", 0.0):
-                problems.append(f"layer {i} l1/l2 regularization")
             if not l.trainable:
                 problems.append(f"layer {i} frozen (trainable=False)")
             if l.updater is not None:
                 problems.append(f"layer {i} per-layer updater override")
-            if type(l).__name__.startswith("BatchNormalization"):
-                problems.append(f"layer {i} batch normalization "
-                                "(running stats frozen on this path)")
             if type(l).__name__ == "CenterLossOutputLayer":
                 problems.append(f"layer {i} center loss (centers state "
                                 "and center term need the fit path)")
