@@ -610,6 +610,9 @@ class SameDiff:
     def sqrt(self, a, name=None):
         return self._op("sqrt", a, name=name)
 
+    def rsqrt(self, a, name=None):
+        return self._op("rsqrt", a, name=name)
+
     def square(self, a, name=None):
         return self._op("square", a, name=name)
 
@@ -652,6 +655,12 @@ class SameDiff:
     def conv2d(self, x, w, strides=(1, 1), padding="same", name=None):
         return self._op("conv2d", x, w,
                         attrs={"strides": list(strides), "padding": padding}, name=name)
+
+    def depthwise_conv2d(self, x, w, strides=(1, 1), padding="same",
+                         name=None):
+        return self._op("depthwise_conv2d", x, w,
+                        attrs={"strides": list(strides),
+                               "padding": padding}, name=name)
 
     def max_pool2d(self, x, kernel=(2, 2), strides=None, padding="valid", name=None):
         return self._op("max_pool2d", x, attrs={

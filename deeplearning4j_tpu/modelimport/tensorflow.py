@@ -1299,6 +1299,8 @@ class TFImportedGraph:
                     attrs={"num_bits": nb, "narrow_range": nr}, name=name)
             elif node.op in ("Identity", "StopGradient", "PreventGradient"):
                 handles[name] = sd.identity(x(0), name=name)
+            elif node.op == "NoOp":
+                continue                    # control-dependency anchor only
             elif node.op == "Reshape":
                 shape = [int(d) for d in const_val(ins[1]).ravel()]
                 handles[name] = sd.reshape(x(0), shape, name=name)
@@ -1342,6 +1344,15 @@ class TFImportedGraph:
                 pads = const_val(ins[1]).reshape(-1, 2)
                 handles[name] = sd.pad(x(0), [(int(a), int(b)) for a, b in pads],
                                        name=name)
+            elif node.op == "Rsqrt":
+                # decomposed batchnorm graphs (keras export without fused
+                # BN) carry 1/sqrt(var+eps) as an explicit Rsqrt node
+                handles[name] = sd.rsqrt(x(0), name=name)
+            elif node.op == "DepthwiseConv2dNative":
+                strides = node.attr("strides").list_i or [1, 1, 1, 1]
+                handles[name] = sd.depthwise_conv2d(
+                    x(0), x(1), strides=tuple(strides[1:3]),
+                    padding=_pad_mode(node).lower(), name=name)
             else:
                 raise NotImplementedError(
                     f"to_samediff: no SameDiff mapping for TF op '{node.op}' "

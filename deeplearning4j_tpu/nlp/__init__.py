@@ -9,6 +9,11 @@ jitted XLA program over batched (center, context, negatives) arrays instead
 of the reference's per-pair Hogwild threads.
 """
 
+from deeplearning4j_tpu.nlp.corpus import (
+    BasicLineIterator, CollectionSentenceIterator, FileLabelAwareIterator,
+    FileSentenceIterator, LabelledDocument, LineSentenceIterator,
+    PhraseDetector, SentencePreProcessor,
+)
 from deeplearning4j_tpu.nlp.tokenizers import (
     DefaultTokenizerFactory, NGramTokenizerFactory,
 )
@@ -18,4 +23,8 @@ from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 
 __all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory", "VocabCache",
-           "Word2Vec", "Glove", "ParagraphVectors"]
+           "Word2Vec", "Glove", "ParagraphVectors",
+           "BasicLineIterator", "CollectionSentenceIterator",
+           "FileLabelAwareIterator", "FileSentenceIterator",
+           "LabelledDocument", "LineSentenceIterator", "PhraseDetector",
+           "SentencePreProcessor"]

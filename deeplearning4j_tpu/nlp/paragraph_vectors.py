@@ -78,6 +78,13 @@ class ParagraphVectors:
     def fit(self, documents: Sequence[str], labels: Optional[Sequence[str]] = None
             ) -> "ParagraphVectors":
         rng = np.random.default_rng(self.seed)
+        documents = list(documents)
+        # label-aware document streams (nlp.corpus.FileLabelAwareIterator /
+        # LabelledDocument) carry their own labels (r4)
+        if documents and hasattr(documents[0], "content"):
+            if labels is None:
+                labels = [d.label for d in documents]
+            documents = [d.content for d in documents]
         sents = [self.tokenizer.tokenize(d) for d in documents]
         self.labels = list(labels) if labels is not None else [
             f"DOC_{i}" for i in range(len(documents))]

@@ -189,11 +189,20 @@ class Word2Vec:
         self.C: Optional[np.ndarray] = None   # output vectors
 
     # ------------------------------------------------------------------- fit
-    def _sentences(self, corpus) -> List[List[str]]:
+    def _iter_token_sents(self, corpus):
+        """Streaming tokenized-sentence view of ``corpus``: a string (split
+        on lines), any iterable of strings/token-lists, or a
+        nlp.corpus.SentenceIterator — nothing is materialized, so file-
+        backed corpora train at any size (r4). For epochs > 1 the corpus
+        must be re-iterable (iterators expose reset(); plain generators
+        are single-pass)."""
         if isinstance(corpus, str):
             corpus = corpus.splitlines()
-        return [self.tokenizer.tokenize(line) if isinstance(line, str) else line
-                for line in corpus]
+        for line in corpus:
+            toks = (self.tokenizer.tokenize(line) if isinstance(line, str)
+                    else list(line))
+            if toks:
+                yield toks
 
     def _pairs(self, encoded: List[np.ndarray], rng) -> np.ndarray:
         """All (center, context) skip-gram pairs with random window shrink."""
@@ -207,10 +216,16 @@ class Word2Vec:
                         pairs.append((sent[i], sent[j]))
         return np.asarray(pairs, np.int32).reshape(-1, 2)
 
-    def fit(self, corpus) -> "Word2Vec":
+    def fit(self, corpus, chunk_sentences: int = 4096) -> "Word2Vec":
+        """Two streaming passes per epoch over ``corpus`` (r4): pass 1
+        builds the vocabulary sentence-by-sentence; each epoch then streams
+        sentences again, encoding + subsampling on the fly and training in
+        chunks of ``chunk_sentences`` — the corpus itself is never
+        materialized, so file-backed SentenceIterators (nlp.corpus) train
+        at any size. Batch shapes are fixed, so every chunk reuses the one
+        compiled XLA step."""
         rng = np.random.default_rng(self.seed)
-        sents = self._sentences(corpus)
-        self.vocab.fit(sents)
+        self.vocab.fit(self._iter_token_sents(corpus))
         V, D = len(self.vocab), self.vector_size
         if V == 0:
             raise ValueError("empty vocabulary")
@@ -219,15 +234,13 @@ class Word2Vec:
         sampler = NegativeSampler(self.vocab.unigram_table_probs())
         keep = (self.vocab.subsample_keep_probs(self.subsample)
                 if self.subsample > 0 else None)
-        encoded = [self.vocab.encode(s) for s in sents]
-        if keep is not None:
-            encoded = [s[rng.random(len(s)) < keep[s]] for s in encoded]
 
         W, C = jnp.asarray(self.W), jnp.asarray(self.C)
         if self.hs and self.cbow:
             raise ValueError("cbow=True with hs=True is not supported; use "
                              "negative sampling for CBOW")
         huffman = None
+        accW = accT = None
         if self.hs and not self.cbow:
             # per-fit: the tree depends on THIS corpus's vocabulary
             freqs = [self.vocab.counts[w_] for w_ in self.vocab.words]
@@ -235,11 +248,13 @@ class Word2Vec:
             C = jnp.asarray(np.zeros((max(V - 1, 1), D), np.float32))
             accW = jnp.zeros_like(W)
             accT = jnp.zeros_like(C)
-        for _ in range(self.epochs):
+
+        def train_chunk(encoded):
+            nonlocal W, C, accW, accT
             if self.cbow:
                 centers, ctxs = cbow_windows(encoded, self.window)
                 if len(centers) == 0:
-                    continue
+                    return
                 order = rng.permutation(len(centers))
                 centers, ctxs = centers[order], ctxs[order]
                 B = min(self.batch_size, len(centers))
@@ -251,7 +266,7 @@ class Word2Vec:
             elif self.hs:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:
-                    continue
+                    return
                 codes_m, points_m, mask_m = huffman
                 pairs = pairs[rng.permutation(len(pairs))]
                 B = min(self.batch_size, len(pairs))
@@ -264,7 +279,7 @@ class Word2Vec:
             else:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:
-                    continue
+                    return
                 pairs = pairs[rng.permutation(len(pairs))]
                 # batches reuse one compiled step shape
                 B = min(self.batch_size, len(pairs))
@@ -274,6 +289,32 @@ class Word2Vec:
                     W, C, _ = _sg_neg_step(W, C, jnp.asarray(batch[:, 0]),
                                            jnp.asarray(batch[:, 1]),
                                            jnp.asarray(negs), lr=self.lr)
+
+        for epoch in range(self.epochs):
+            if hasattr(corpus, "reset"):
+                corpus.reset()
+            buf = []
+            seen = 0
+            for toks in self._iter_token_sents(corpus):
+                seen += 1
+                enc = self.vocab.encode(toks)
+                if keep is not None and len(enc):
+                    enc = enc[rng.random(len(enc)) < keep[enc]]
+                if len(enc):
+                    buf.append(enc)
+                if len(buf) >= chunk_sentences:
+                    train_chunk(buf)
+                    buf = []
+            if buf:
+                train_chunk(buf)
+            if seen == 0 and epoch == 0:
+                # a single-pass generator was exhausted by the vocabulary
+                # pass — fail loud instead of returning random embeddings
+                raise ValueError(
+                    "corpus yielded no sentences on the training pass; "
+                    "fit() makes one vocabulary pass plus one pass per "
+                    "epoch, so pass a re-iterable (list, str, or a "
+                    "nlp.corpus SentenceIterator), not a generator")
         self.W, self.C = np.asarray(W), np.asarray(C)
         return self
 

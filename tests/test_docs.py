@@ -1,0 +1,189 @@
+"""Execute the fenced python snippets in docs/*.md (VERDICT r3 #9).
+
+Reference analog: the reference CI smoke-runs dl4j-examples; the guides
+here are executable documentation — every ```python block in a guide runs
+in this suite, sequentially per file in one namespace (snippets may build
+on earlier ones, literate-style), from a temp working directory. A guide
+whose snippet references an input (a CSV file, a model checkpoint, arrays)
+gets a SETUP preamble below providing a tiny instance of it; if a doc edit
+introduces a name no setup defines, this test fails — that's the point.
+
+Blocks opened with ```python notest are syntax-checked (ast.parse) but not
+executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from pathlib import Path
+
+import pytest
+
+DOCS = Path(__file__).resolve().parent.parent / "docs"
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+_BLOCK = re.compile(r"^```python([^\n]*)\n(.*?)^```\s*$",
+                    re.MULTILINE | re.DOTALL)
+
+
+def _blocks(md_path: Path):
+    text = md_path.read_text()
+    return [(m.group(1).strip(), m.group(2)) for m in _BLOCK.finditer(text)]
+
+
+# --------------------------------------------------------------------------
+# per-doc setup preambles: define the tiny inputs the guide's snippets use
+# --------------------------------------------------------------------------
+
+SETUP = {
+    "getting_started.md": """
+import numpy as np
+""",
+    "datavec.md": """
+import numpy as np
+from deeplearning4j_tpu.datavec import CSVRecordReader, Schema
+from deeplearning4j_tpu.native.pipeline import write_image_dataset
+
+with open("data.csv", "w") as f:
+    f.write("1.0,2.0,A\\n3.0,-9.0,B\\n4.0,5.0,C\\n2.0,1.0,A\\n")
+
+# group-by / join inputs
+left = (Schema.builder().add_column_integer("id")
+        .add_column_double("x").build())
+right = (Schema.builder().add_column_integer("id")
+         .add_column_double("z").build())
+left_records = [[1, 2.0], [2, 3.0]]
+right_records = [[1, 9.0]]
+
+# a reader (numeric labels) + matching model for the iterator snippet
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+with open("data_num.csv", "w") as f:
+    f.write("1.0,2.0,0\\n3.0,-9.0,1\\n4.0,5.0,2\\n2.0,1.0,0\\n")
+reader = CSVRecordReader("data_num.csv")
+_conf = (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_out=8, activation="relu"))
+         .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.feed_forward(2)).build())
+model = MultiLayerNetwork(_conf).init()
+
+# a tiny stored image dataset for the native pipeline snippet
+_rng = np.random.default_rng(0)
+_imgs = _rng.integers(0, 256, (8, 256, 256, 3), dtype=np.uint8)
+_labels = np.eye(1000, dtype=np.float32)[_rng.integers(0, 1000, 8)]
+img_path, label_path = write_image_dataset(".", _imgs, _labels)
+n = 8
+""",
+    "long_context.md": """
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# zigzag needs head_dim % 128 == 0 and T divisible into 8-multiple stripes
+_rng = np.random.default_rng(0)
+_T = 16 * jax.device_count()
+q = k = v = jnp.asarray(_rng.normal(size=(1, 1, _T, 128)), jnp.float32)
+H = 1
+n_steps = 1
+x = jnp.asarray(_rng.normal(size=(1, _T, 128)), jnp.float32)
+y = x
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.attention import TransformerEncoderLayer
+_enc = TransformerEncoderLayer(d_model=128, n_heads=H, causal=True)
+params, _ = _enc.init(jax.random.key(0),
+                      InputType.recurrent(128, _T))
+""",
+    "model_import.md": """
+import shutil
+import numpy as np
+shutil.copy(r"{fx}/model_k3.keras", "model.keras")
+shutil.copy(r"{fx}/tf_small_cnn.pb", "frozen.pb")
+shutil.copy(r"{fx}/bert_tiny.onnx", "model.onnx")
+shutil.copytree(r"{fx}/saved_model_cnn", "export_dir")
+# a legacy whole-model h5, written by live keras (present in the test image)
+keras = __import__("pytest").importorskip("tensorflow.keras",
+                                          reason="needs tensorflow")
+_m = keras.Sequential([keras.layers.Input((4,)),
+                       keras.layers.Dense(3, activation="softmax")])
+_m.save("model.h5")
+x = np.load(r"{fx}/saved_model_cnn_golden.npz")["x"]
+""",
+    "parallelism.md": """
+import numpy as np
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+_conf = (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_out=8, activation="relu"))
+         .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.feed_forward(8)).build())
+model = MultiLayerNetwork(_conf).init()
+_rng = np.random.default_rng(0)
+train_iterator = ArrayDataSetIterator(
+    _rng.normal(size=(64, 8)).astype(np.float32),
+    np.eye(4, dtype=np.float32)[_rng.integers(0, 4, 64)], batch_size=16)
+""",
+    "rl.md": "",
+    "nlp.md": """
+import os
+os.makedirs("corpus_dir", exist_ok=True)
+with open("corpus_dir/a.txt", "w") as f:
+    f.write("the cat sat on the mat\\n" * 20
+            + "the dog ran in the park\\n" * 20)
+for _lab in ("animals", "finance"):
+    os.makedirs(os.path.join("labelled", _lab), exist_ok=True)
+    with open(os.path.join("labelled", _lab, "d0.txt"), "w") as f:
+        f.write("market stocks trading higher today" if _lab == "finance"
+                else "the cat and the dog played outside")
+""",
+}
+
+# snippet-level parameter shrink: the docs show realistic sizes; the suite
+# runs the same CODE with smaller knobs by rewriting literal arguments
+SHRINK = {
+    "getting_started.md": [
+        ('ResNet50(height=224, width=224, num_classes=1000, dtype="bf16")',
+         'ResNet50(height=32, width=32, num_classes=10, dtype="float32")'),
+        ("n_examples=2048", "n_examples=256"),
+        ("n_examples=1024", "n_examples=256"),
+        ("for epoch in range(3):", "for epoch in range(1):"),
+    ],
+    "nlp.md": [
+        ("vector_size=128", "vector_size=16"),
+        ("vector_size=100", "vector_size=16"),
+        ("epochs=5", "epochs=1"),
+        ("epochs=10", "epochs=2"),
+    ],
+    "rl.md": [
+        ("dqn.train(60)", "dqn.train(8)"),
+        ("a3c.train(20)", "a3c.train(3)"),
+        ("n_envs=8", "n_envs=2"),
+    ],
+    "datavec.md": [
+        ("batch=256", "batch=8"),
+    ],
+}
+
+
+@pytest.mark.parametrize("doc", sorted(p.name for p in DOCS.glob("*.md")))
+def test_doc_snippets_execute(doc, tmp_path, monkeypatch):
+    blocks = _blocks(DOCS / doc)
+    if not blocks:
+        pytest.skip(f"{doc} has no python snippets")
+    monkeypatch.chdir(tmp_path)
+    ns: dict = {"__name__": f"docs_{doc.replace('.', '_')}"}
+    setup = SETUP.get(doc, "")
+    if setup:
+        exec(compile(setup.replace("{fx}", str(FIXTURES)),
+                     f"docs/{doc}:setup", "exec"), ns)
+    for i, (info, src) in enumerate(blocks):
+        for old, new in SHRINK.get(doc, []):
+            src = src.replace(old, new)
+        if "notest" in info:
+            ast.parse(src)          # syntax-checked, not executed
+            continue
+        exec(compile(src, f"docs/{doc}:block{i}", "exec"), ns)
