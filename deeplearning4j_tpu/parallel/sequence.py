@@ -36,8 +36,10 @@ from jax.sharding import PartitionSpec as P
 from deeplearning4j_tpu.parallel._compat import pvary as _pvary, shard_map
 
 
-def _ring_attention_local(q, k, v, *, axis, causal, scale):
-    """Per-device body. q/k/v local blocks [B, H, Tq, D] / [B, H, Tk, D]."""
+def _ring_attention_local(q, k, v, kmask=None, *, axis, causal, scale):
+    """Per-device body. q/k/v local blocks [B, H, Tq, D] / [B, H, Tk, D];
+    ``kmask`` an optional key-padding shard [B, Tk] (>0 = visible) that
+    rotates around the ring WITH its K/V block (r4)."""
     axis_size = lax.psum(1, axis)
     my_idx = lax.axis_index(axis)
     B, H, Tq, D = q.shape
@@ -51,17 +53,24 @@ def _ring_attention_local(q, k, v, *, axis, causal, scale):
     m0 = _pvary(jnp.full((B, H, Tq, 1), neg, jnp.float32), (axis,))
     l0 = _pvary(jnp.zeros((B, H, Tq, 1), jnp.float32), (axis,))
     o0 = _pvary(jnp.zeros((B, H, Tq, D), jnp.float32), (axis,))
-
     qpos = my_idx * Tq + jnp.arange(Tq)
+    # kmask is a TRACE-time branch: without a mask the carry omits the mask
+    # shard entirely (no dead ppermute per ring step)
+    has_km = kmask is not None
 
     def body(i, carry):
-        m, l, o, k, v = carry
+        if has_km:
+            m, l, o, k, v, km = carry
+        else:
+            m, l, o, k, v = carry
         src = (my_idx - i) % axis_size  # which global block we currently hold
         logits = jnp.einsum("bhqd,bhkd->bhqk", q32, k.astype(jnp.float32))
         if causal:
             kpos = src * Tk + jnp.arange(Tk)
             mask = qpos[:, None] >= kpos[None, :]
             logits = jnp.where(mask, logits, neg)
+        if has_km:
+            logits = jnp.where(km[:, None, None, :] > 0, logits, neg)
         m_new = jnp.maximum(m, logits.max(-1, keepdims=True))
         p = jnp.exp(logits - m_new)
         corr = jnp.exp(m - m_new)
@@ -70,9 +79,16 @@ def _ring_attention_local(q, k, v, *, axis, causal, scale):
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k = lax.ppermute(k, axis, perm)
         v = lax.ppermute(v, axis, perm)
+        if has_km:
+            km = lax.ppermute(km, axis, perm)
+            return m_new, l, o, k, v, km
         return m_new, l, o, k, v
 
-    m, l, o, _, _ = lax.fori_loop(0, axis_size, body, (m0, l0, o0, k, v))
+    carry0 = (m0, l0, o0, k, v)
+    if has_km:
+        carry0 = carry0 + (kmask.astype(jnp.float32),)
+    out = lax.fori_loop(0, axis_size, body, carry0)
+    l, o = out[1], out[2]
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
@@ -102,7 +118,8 @@ def _merge_lse(o, lse, o_i, lse_i):
     return o * w_old + o_i.astype(jnp.float32) * w_new, lse_new
 
 
-def _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k):
+def _ring_flash_fwd_impl(q, k, v, kmask, axis, causal, scale, block_q,
+                         block_k):
     from deeplearning4j_tpu.ops.pallas.flash_attention import flash_block_fwd
 
     n = lax.psum(1, axis)
@@ -112,46 +129,55 @@ def _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k):
     lse = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
     o, lse = _pvary(o, (axis,)), _pvary(lse, (axis,))
     k_cur, v_cur = k, v
+    km_cur = None if kmask is None else kmask.astype(jnp.float32)
     blk = functools.partial(flash_block_fwd, scale=scale,
                             block_q=block_q, block_k=block_k, vma=(axis,))
     for i in range(n):
         if i == 0:
             # the diagonal block: start-aligned causal mask is exact here
-            o_i, lse_i = blk(q, k_cur, v_cur, causal=causal)
+            o_i, lse_i = blk(q, k_cur, v_cur, causal=causal, kmask=km_cur)
         elif causal:
             src = (my - i) % n  # which global K/V block we currently hold
             o_i, lse_i = lax.cond(
                 src < my,
-                lambda kv: blk(q, kv[0], kv[1], causal=False),
+                lambda kv: blk(q, kv[0], kv[1], causal=False, kmask=kv[2]),
                 lambda kv: (jnp.zeros((B, H, Tq, D), q.dtype),
                             jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)),
-                (k_cur, v_cur))
+                (k_cur, v_cur, km_cur))
         else:
-            o_i, lse_i = blk(q, k_cur, v_cur, causal=False)
+            o_i, lse_i = blk(q, k_cur, v_cur, causal=False, kmask=km_cur)
+        # a fully-masked step emits lse=+inf; _merge_lse normalizes it to
+        # "contributes nothing", so padded-out blocks drop out exactly
         o, lse = _merge_lse(o, lse, o_i, lse_i)
         if i < n - 1:
             k_cur = _rotate(k_cur, axis, n)
             v_cur = _rotate(v_cur, axis, n)
+            if km_cur is not None:
+                km_cur = _rotate(km_cur, axis, n)
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k):
-    return _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, kmask, axis, causal, scale, block_q, block_k):
+    return _ring_flash_fwd_impl(q, k, v, kmask, axis, causal, scale,
+                                block_q, block_k)[0]
 
 
-def _ring_flash_vjp_fwd(q, k, v, axis, causal, scale, block_q, block_k):
-    o, lse = _ring_flash_fwd_impl(q, k, v, axis, causal, scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _ring_flash_vjp_fwd(q, k, v, kmask, axis, causal, scale, block_q,
+                        block_k):
+    o, lse = _ring_flash_fwd_impl(q, k, v, kmask, axis, causal, scale,
+                                  block_q, block_k)
+    return o, (q, k, v, kmask, o, lse)
 
 
 def _ring_flash_vjp_bwd(axis, causal, scale, block_q, block_k, res, do):
-    """True ring backward: K/V re-rotate while each block's dk/dv partial
-    travels WITH it; after n steps every carry is home with contributions
-    from every device. Per-device memory stays O(Tq/n * D)."""
+    """True ring backward: K/V (and the key-padding mask shard) re-rotate
+    while each block's dk/dv partial travels WITH it; after n steps every
+    carry is home with contributions from every device. Per-device memory
+    stays O(Tq/n * D)."""
     from deeplearning4j_tpu.ops.pallas.flash_attention import flash_block_bwd
 
-    q, k, v, o, lse = res
+    q, k, v, kmask, o, lse = res
     n = lax.psum(1, axis)
     my = lax.axis_index(axis)
     delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
@@ -160,6 +186,7 @@ def _ring_flash_vjp_bwd(axis, causal, scale, block_q, block_k, res, do):
     dk_carry = _pvary(jnp.zeros(k.shape, jnp.float32), (axis,))
     dv_carry = _pvary(jnp.zeros(v.shape, jnp.float32), (axis,))
     k_cur, v_cur = k, v
+    km_cur = None if kmask is None else kmask.astype(jnp.float32)
     # bwd kernels want large tiles, bounded by VMEM (see bwd_tiles)
     from deeplearning4j_tpu.ops.pallas.flash_attention import bwd_tiles
 
@@ -168,18 +195,21 @@ def _ring_flash_vjp_bwd(axis, causal, scale, block_q, block_k, res, do):
                             block_q=bwq, block_k=bwk, vma=(axis,))
     for i in range(n):
         if i == 0:
-            dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta, causal=causal)
+            dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta,
+                                   causal=causal, kmask=km_cur)
         elif causal:
             src = (my - i) % n
             dq_i, dk_i, dv_i = lax.cond(
                 src < my,
-                lambda kv: blk(q, kv[0], kv[1], do, lse, delta, causal=False),
+                lambda kv: blk(q, kv[0], kv[1], do, lse, delta,
+                               causal=False, kmask=kv[2]),
                 lambda kv: (jnp.zeros(q.shape, jnp.float32),
                             jnp.zeros(k.shape, jnp.float32),
                             jnp.zeros(v.shape, jnp.float32)),
-                (k_cur, v_cur))
+                (k_cur, v_cur, km_cur))
         else:
-            dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta, causal=False)
+            dq_i, dk_i, dv_i = blk(q, k_cur, v_cur, do, lse, delta,
+                                   causal=False, kmask=km_cur)
         dq = dq + dq_i
         dk_carry = dk_carry + dk_i
         dv_carry = dv_carry + dv_i
@@ -189,17 +219,21 @@ def _ring_flash_vjp_bwd(axis, causal, scale, block_q, block_k, res, do):
         if i < n - 1:
             k_cur = _rotate(k_cur, axis, n)
             v_cur = _rotate(v_cur, axis, n)
+            if km_cur is not None:
+                km_cur = _rotate(km_cur, axis, n)
         dk_carry = _rotate(dk_carry, axis, n)
         dv_carry = _rotate(dv_carry, axis, n)
-    return dq.astype(q.dtype), dk_carry.astype(k.dtype), dv_carry.astype(v.dtype)
+    dkm = None if kmask is None else jnp.zeros_like(kmask)
+    return (dq.astype(q.dtype), dk_carry.astype(k.dtype),
+            dv_carry.astype(v.dtype), dkm)
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
-def _ring_flash_local(q, k, v, *, axis, causal, scale,
+def _ring_flash_local(q, k, v, kmask=None, *, axis, causal, scale,
                       block_q=512, block_k=1024):
-    return _ring_flash(q, k, v, axis, causal, scale,
+    return _ring_flash(q, k, v, kmask, axis, causal, scale,
                        min(block_q, q.shape[2]), min(block_k, k.shape[2]))
 
 
@@ -220,7 +254,8 @@ def _select_ring_core(head_dim: int, t_local: int):
 
 
 def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
-                   scale: float | None = None, impl: str | None = None):
+                   scale: float | None = None, impl: str | None = None,
+                   mask=None):
     """Ring attention over a mesh axis.
 
     q/k/v: [B, H, T, D] with T sharded over ``axis`` (logically; pass the
@@ -228,7 +263,13 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
 
     impl: None (auto: flash kernel core when shapes are TPU-aligned),
     "flash", or "einsum".
-    """
+
+    mask (r4): optional key-padding mask [B, T] (>0 = key visible), sharded
+    over ``axis`` like the keys; each shard travels the ring WITH its K/V
+    block, so padded-batch long-context training works without ever
+    materializing a [T, T] mask. Rows whose keys are ALL masked follow the
+    local core's convention (flash core: exact zeros; einsum core: uniform
+    attention, matching the plain XLA lowering)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     size = mesh.shape[axis]
@@ -243,14 +284,26 @@ def ring_attention(q, k, v, mesh, *, axis: str = "seq", causal: bool = False,
         local, check_vma = _ring_flash_local, False
     else:
         local, check_vma = _ring_attention_local, True
+    body = functools.partial(local, axis=axis, causal=causal, scale=scale)
+    if mask is None:
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, None, axis, None),) * 3,
+            out_specs=P(None, None, axis, None),
+            check_vma=check_vma,
+        )
+        return fn(q, k, v)
+    if tuple(mask.shape) != (q.shape[0], k.shape[2]):
+        raise ValueError(f"ring_attention mask must be a key-padding mask "
+                         f"[B, T] = {(q.shape[0], k.shape[2])}; got "
+                         f"{tuple(mask.shape)}")
     fn = shard_map(
-        functools.partial(local, axis=axis, causal=causal, scale=scale),
-        mesh=mesh,
-        in_specs=(P(None, None, axis, None),) * 3,
+        body, mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3 + (P(None, axis),),
         out_specs=P(None, None, axis, None),
         check_vma=check_vma,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, mask)
 
 
 def _ulysses_local(q, k, v, *, axis, causal, scale):

@@ -86,6 +86,7 @@ import jax.numpy as jnp
 _rng = np.random.default_rng(0)
 _T = 16 * jax.device_count()
 q = k = v = jnp.asarray(_rng.normal(size=(1, 1, _T, 128)), jnp.float32)
+key_mask = jnp.ones((1, _T), jnp.float32).at[0, -_T // 4:].set(0)
 H = 1
 n_steps = 1
 x = jnp.asarray(_rng.normal(size=(1, _T, 128)), jnp.float32)

@@ -122,6 +122,69 @@ class TestRingAttention:
         ref = self._reference_attention(q, k, v)
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_masked_ring_einsum_core(self, rng, causal):
+        """r4: a key-padding mask shard travels the ring with its K/V
+        block — padded-batch long context without a [T, T] mask. Einsum
+        core (unaligned head_dim), fwd + dq, vs the plain XLA lowering."""
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+        mesh = DeviceMesh(data=1, seq=8)
+        B, H, T, D = 2, 2, 64, 16
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        m = np.ones((B, T), np.float32)
+        m[0, 40:] = 0                   # pads span shard boundaries
+        m[1, :8] = 0                    # a fully-masked LEADING shard
+        mask = jnp.asarray(m)
+        out = ring_attention(q, k, v, mesh.mesh, causal=causal, mask=mask)
+        ref = dot_product_attention(q, k, v, mask=mask[:, None, None, :],
+                                    causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        if not causal:
+            g1 = jax.grad(lambda q: ring_attention(
+                q, k, v, mesh.mesh, mask=mask).sum())(q)
+            g2 = jax.grad(lambda q: dot_product_attention(
+                q, k, v, mask=mask[:, None, None, :]).sum())(q)
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_masked_ring_flash_core(self, rng, causal):
+        """The flash-kernel ring core with a traveling mask shard: fwd and
+        the true ring backward (dk/dv travel with their blocks), including
+        the causal branch's lax.cond mask plumbing."""
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+        mesh = DeviceMesh(data=1, seq=8)
+        B, H, T, D = 1, 1, 128, 128
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+        m = np.ones((B, T), np.float32)
+        m[0, 96:] = 0                   # last two shards fully masked
+        mask = jnp.asarray(m)
+        out = ring_attention(q, q, q, mesh.mesh, impl="flash", mask=mask,
+                             causal=causal)
+        ref = dot_product_attention(q, q, q, mask=mask[:, None, None, :],
+                                    causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        gf = jax.grad(lambda q: ring_attention(
+            q, q, q, mesh.mesh, impl="flash", mask=mask,
+            causal=causal).sum())(q)
+        gr = jax.grad(lambda q: dot_product_attention(
+            q, q, q, mask=mask[:, None, None, :], causal=causal).sum())(q)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_masked_ring_rejects_bad_mask_shape(self, rng):
+        mesh = DeviceMesh(data=1, seq=8)
+        q = jnp.zeros((2, 2, 64, 16), jnp.float32)
+        with pytest.raises(ValueError, match="key-padding"):
+            ring_attention(q, q, q, mesh.mesh,
+                           mask=jnp.ones((2, 2, 64, 64)))
+
 
 class TestTensorParallel:
     def test_tp_matches_single_device(self, rng):
