@@ -9,6 +9,7 @@ jitted XLA program over batched (center, context, negatives) arrays instead
 of the reference's per-pair Hogwild threads.
 """
 
+from deeplearning4j_tpu.nlp.bert import BertIterator, BertWordPieceTokenizer
 from deeplearning4j_tpu.nlp.corpus import (
     BasicLineIterator, CollectionSentenceIterator, FileLabelAwareIterator,
     FileSentenceIterator, LabelledDocument, LineSentenceIterator,
@@ -27,4 +28,4 @@ __all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory", "VocabCache",
            "BasicLineIterator", "CollectionSentenceIterator",
            "FileLabelAwareIterator", "FileSentenceIterator",
            "LabelledDocument", "LineSentenceIterator", "PhraseDetector",
-           "SentencePreProcessor"]
+           "SentencePreProcessor", "BertIterator", "BertWordPieceTokenizer"]

@@ -131,6 +131,10 @@ train_iterator = ArrayDataSetIterator(
     "rl.md": "",
     "nlp.md": """
 import os
+with open("vocab.txt", "w") as f:
+    f.write("\\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                        "great", "movie", "dull", "plot", "a", "sentence",
+                        "per", "line"]))
 os.makedirs("corpus_dir", exist_ok=True)
 with open("corpus_dir/a.txt", "w") as f:
     f.write("the cat sat on the mat\\n" * 20

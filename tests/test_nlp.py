@@ -334,3 +334,138 @@ class TestCorpusStreaming:
         assert sorted(set(pv.labels)) == ["animals", "finance"]
         assert pv.doc_vectors.shape == (6, 24)
         assert np.isfinite(pv.doc_vectors).all()
+
+
+class TestBertFront:
+    """r4: BertWordPieceTokenizer + BertIterator (the reference's
+    deeplearning4j-nlp BERT text front)."""
+
+    VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "cat", "sat", "mat", "un", "##aff", "##able",
+             "##s", "run", "##ning", ",", "."]
+
+    def _tok(self):
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizer
+
+        return BertWordPieceTokenizer(self.VOCAB)
+
+    def test_wordpiece_longest_match(self):
+        tok = self._tok()
+        assert tok.tokenize("unaffable") == ["un", "##aff", "##able"]
+        assert tok.tokenize("running") == ["run", "##ning"]
+        assert tok.tokenize("cats") == ["cat", "##s"]
+        # punctuation splits; unknown words collapse to [UNK]
+        assert tok.tokenize("The cat, zzz.") == [
+            "the", "cat", ",", "[UNK]", "."]
+
+    def test_vocab_file_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import BertWordPieceTokenizer
+
+        p = tmp_path / "vocab.txt"
+        p.write_text("\n".join(self.VOCAB))
+        tok = BertWordPieceTokenizer(str(p))
+        assert tok.encode("the mat") == [5, 8]
+
+    def test_seq_classification_batches(self):
+        from deeplearning4j_tpu.nlp import BertIterator
+
+        sents = [("the cat sat", "A"), ("the mat", "B"),
+                 ("cat cat cat", "A")]
+        it = BertIterator(self._tok(), sents, batch_size=2, max_len=8,
+                          task="seq_classification", labels=["A", "B"])
+        batches = list(it)
+        assert len(batches) == 2
+        ds = batches[0]
+        assert ds.features.shape == (2, 8) and ds.features.dtype == np.int32
+        # [CLS] ... [SEP] framing and the padding mask agree
+        assert ds.features[0, 0] == 2            # [CLS]
+        n_real = int(ds.features_mask[0].sum())
+        assert ds.features[0, n_real - 1] == 3   # [SEP]
+        assert (ds.features[0, n_real:] == 0).all()
+        assert ds.labels.shape == (2, 2)
+        assert ds.labels[0].argmax() == 0 and ds.labels[1].argmax() == 1
+
+    def test_trailing_batch_padded_to_fixed_shape(self):
+        from deeplearning4j_tpu.nlp import BertIterator
+
+        sents = [("the cat", "A")] * 5          # 5 rows, batch 2 -> 2,2,1+pad
+        it = BertIterator(self._tok(), sents, batch_size=2, max_len=8,
+                          task="seq_classification", labels=["A", "B"])
+        batches = list(it)
+        assert [b.features.shape[0] for b in batches] == [2, 2, 2]
+        tail = batches[-1]
+        # the pad row: zero mask, zero label vector -> no loss contribution
+        assert tail.features_mask[1].sum() == 0
+        assert tail.labels[1].sum() == 0
+        # and can be disabled for the reference's unpadded behavior
+        it2 = BertIterator(self._tok(), sents, batch_size=2, max_len=8,
+                           task="seq_classification", labels=["A", "B"],
+                           pad_minibatches=False)
+        assert [b.features.shape[0] for b in it2] == [2, 2, 1]
+
+    def test_mask_prob_zero_is_passthrough(self):
+        from deeplearning4j_tpu.nlp import BertIterator
+
+        it = BertIterator(self._tok(), ["the cat sat"] * 2, batch_size=2,
+                          max_len=8, task="unsupervised", mask_prob=0.0)
+        ds = next(iter(it))
+        assert (ds.features == ds.labels).all()
+        assert ds.labels_mask.sum() == 0
+
+    def test_cls_without_sep_rejected(self):
+        from deeplearning4j_tpu.nlp import BertIterator, BertWordPieceTokenizer
+
+        tok = BertWordPieceTokenizer(["[PAD]", "[UNK]", "[CLS]", "the"])
+        with __import__("pytest").raises(ValueError, match="SEP"):
+            BertIterator(tok, ["the"], task="seq_classification",
+                         labels=["A"])
+
+    def test_masked_lm_batches(self):
+        from deeplearning4j_tpu.nlp import BertIterator
+
+        sents = ["the cat sat the mat the cat sat"] * 4
+        it = BertIterator(self._tok(), sents, batch_size=4, max_len=16,
+                          task="unsupervised", mask_prob=0.3, seed=5)
+        ds = next(iter(it))
+        assert ds.labels_mask is not None and ds.labels_mask.sum() > 0
+        sel = ds.labels_mask.astype(bool)
+        # labels hold the ORIGINAL ids everywhere; corruption only at sel
+        assert (ds.labels[~sel] == ds.features[~sel]).all()
+        changed = ds.features[sel] != ds.labels[sel]
+        assert changed.mean() > 0.5              # ~90% masked-or-random
+        # special positions are never selected
+        assert not sel[:, 0].any()
+        # deterministic under reset
+        it.reset()
+        ds2 = next(iter(it))
+        assert (ds2.features == ds.features).all()
+
+    def test_mlm_trains_through_graph_tier(self):
+        """End-to-end: masked-LM batches feed an rnn-output classifier over
+        token ids; the masked loss uses labels_mask (per-position)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nlp import BertIterator
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (EmbeddingSequenceLayer,
+                                                  RnnOutputLayer)
+        from deeplearning4j_tpu.optimize import Adam
+
+        V = len(self.VOCAB)
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(lr=5e-3)).list()
+                .layer(EmbeddingSequenceLayer(n_in=V, n_out=16))
+                .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(V, 16)).build())
+        net = MultiLayerNetwork(conf).init()
+        sents = ["the cat sat the mat", "the mat the cat", "cat sat mat"] * 4
+        it = BertIterator(self._tok(), sents, batch_size=12, max_len=16,
+                          task="unsupervised", seed=1)
+        ds = it.one_hot(next(iter(it)))
+        s0 = net.score(ds)
+        for _ in range(20):
+            net.fit_batch(ds)
+        s1 = net.score(ds)
+        assert np.isfinite(s1) and s1 < s0, (s0, s1)
