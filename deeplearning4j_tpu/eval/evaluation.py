@@ -61,22 +61,38 @@ class Evaluation:
             self.cm = ConfusionMatrix(self.n_classes)
 
     def eval(self, labels, predictions, mask=None):
-        """Accumulate a batch. labels/predictions: one-hot/prob [B, C] (or [B,T,C] with mask)."""
+        """Accumulate a batch. labels: one-hot [B, C] (or [B, T, C]) OR
+        integer class ids [B] / [B, T] (the sparse_mcxent convention, r4);
+        predictions: probabilities with a trailing class axis; sequence
+        shapes flatten with the optional mask."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
-        if labels.ndim == 3:  # time series: flatten with mask
+        # sparse (integer-id) labels: one fewer dim than the predictions
+        sparse = (predictions.ndim >= 2
+                  and labels.ndim == predictions.ndim - 1)
+        if predictions.ndim == 3:  # time series: flatten with mask
             if mask is not None:
                 m = np.asarray(mask).reshape(-1).astype(bool)
             else:
-                m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
-            labels = labels.reshape(-1, labels.shape[-1])[m]
+                m = np.ones(predictions.shape[0] * predictions.shape[1],
+                            dtype=bool)
+            if sparse:
+                labels = labels.reshape(-1)[m]
+            else:
+                labels = labels.reshape(-1, labels.shape[-1])[m]
             predictions = predictions.reshape(-1, predictions.shape[-1])[m]
         elif mask is not None:
             m = np.asarray(mask).reshape(-1).astype(bool)
             labels, predictions = labels[m], predictions[m]
-        n = labels.shape[-1] if labels.ndim >= 2 else int(max(labels.max(), predictions.max()) + 1)
+        if sparse:
+            n = predictions.shape[-1]
+        elif labels.ndim >= 2:
+            n = labels.shape[-1]
+        else:
+            n = int(max(labels.max(), predictions.max()) + 1)
         self._ensure(n)
-        actual = _to_class_indices(labels)
+        actual = (labels.astype(np.int64) if sparse
+                  else _to_class_indices(labels))
         # top-N bookkeeping needs the probability matrix
         if predictions.ndim >= 2 and predictions.shape[-1] > 1 and self.top_n > 1:
             order = np.argsort(-predictions, axis=-1)[:, : self.top_n]

@@ -27,7 +27,8 @@ from deeplearning4j_tpu.ops.losses import get_loss
 def _fused(activation: str, loss: str) -> bool:
     a = activation.lower().replace("_", "")
     l = loss.lower().replace("_", "")
-    return (a == "softmax" and l in ("mcxent", "negativeloglikelihood")) or (
+    return (a == "softmax" and l in ("mcxent", "negativeloglikelihood",
+                                     "sparsemcxent")) or (
         a == "sigmoid" and l == "xent"
     )
 

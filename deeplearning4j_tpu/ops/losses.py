@@ -31,16 +31,49 @@ def _reduce(per_elem, mask):
     return score
 
 
+def _logp(output, from_logits):
+    """Shared stable log-probability path (mcxent / sparse_mcxent)."""
+    if from_logits:
+        return jax.nn.log_softmax(output, axis=-1)
+    return jnp.log(jnp.clip(output, _EPS, 1.0))
+
+
+def _fold_mask(per, mask):
+    """Fold a same-rank mask into the per-element scores; return the
+    (possibly consumed) mask for _reduce."""
+    if mask is not None and mask.ndim == per.ndim:
+        return per * mask, None
+    return per, mask
+
+
 def mcxent(labels, output, mask=None, from_logits=False):
     """Multi-class cross entropy (DL4J MCXENT / NEGATIVELOGLIKELIHOOD)."""
-    if from_logits:
-        logp = jax.nn.log_softmax(output, axis=-1)
-    else:
-        logp = jnp.log(jnp.clip(output, _EPS, 1.0))
-    per = -(labels * logp)
-    if mask is not None and mask.ndim == per.ndim:
-        per = per * mask
-        mask = None
+    per, mask = _fold_mask(-(labels * _logp(output, from_logits)), mask)
+    return _reduce(per, mask)
+
+
+def sparse_mcxent(labels, output, mask=None, from_logits=False):
+    """Integer-label cross entropy (DL4J LossSparseMCXENT): ``labels`` are
+    class INDICES (shape = output.shape minus the class axis), never
+    one-hot — a [B, T] int array against a [B, T, V] output, so a 30k-word
+    masked-LM head pays O(B*T) label memory instead of O(B*T*V). Same
+    masking/reduction semantics as mcxent (r4).
+
+    Out-of-range indices follow take_along_axis's jit semantics (clamped
+    to the last class) — size the output layer to the FULL vocabulary."""
+    logp = _logp(output, from_logits)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    if labels.ndim == logp.ndim:
+        # trailing singleton index dim (the RNN score path reshapes labels
+        # to [B*T, 1]); a real one-hot here means the caller wanted mcxent
+        if labels.shape[-1] != 1:
+            raise ValueError(
+                f"sparse_mcxent takes class INDICES (trailing dim 1 or "
+                f"absent); got labels {labels.shape} against output "
+                f"{output.shape} — one-hot labels belong to loss='mcxent'")
+        labels = labels[..., 0]
+    per = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    per, mask = _fold_mask(per, mask)
     return _reduce(per, mask)
 
 
@@ -114,6 +147,7 @@ def msle(labels, output, mask=None, **_):
 LOSSES: dict[str, Callable] = {
     "mcxent": mcxent,
     "negativeloglikelihood": mcxent,
+    "sparsemcxent": sparse_mcxent,
     "xent": xent,
     "mse": mse,
     "l2": l2,

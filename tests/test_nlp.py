@@ -440,6 +440,35 @@ class TestBertFront:
         ds2 = next(iter(it))
         assert (ds2.features == ds.features).all()
 
+    def test_mlm_trains_with_sparse_labels(self):
+        """r4: sparse_mcxent (DL4J LossSparseMCXENT) consumes the
+        iterator's int-id labels DIRECTLY — no [B, L, V] one-hot — which
+        is what makes masked-LM practical at real vocab sizes."""
+        from deeplearning4j_tpu.nlp import BertIterator
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import (EmbeddingSequenceLayer,
+                                                  RnnOutputLayer)
+        from deeplearning4j_tpu.optimize import Adam
+
+        V = len(self.VOCAB)
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Adam(lr=5e-3)).list()
+                .layer(EmbeddingSequenceLayer(n_in=V, n_out=16))
+                .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                      loss="sparse_mcxent"))
+                .set_input_type(InputType.recurrent(V, 16)).build())
+        net = MultiLayerNetwork(conf).init()
+        sents = ["the cat sat the mat", "the mat the cat"] * 6
+        it = BertIterator(self._tok(), sents, batch_size=12, max_len=16,
+                          task="unsupervised", seed=2)
+        ds = next(iter(it))           # int-id labels, no one_hot()
+        s0 = net.score(ds)
+        for _ in range(20):
+            net.fit_batch(ds)
+        s1 = net.score(ds)
+        assert np.isfinite(s1) and s1 < s0, (s0, s1)
+
     def test_mlm_trains_through_graph_tier(self):
         """End-to-end: masked-LM batches feed an rnn-output classifier over
         token ids; the masked loss uses labels_mask (per-position)."""
@@ -469,3 +498,58 @@ class TestBertFront:
             net.fit_batch(ds)
         s1 = net.score(ds)
         assert np.isfinite(s1) and s1 < s0, (s0, s1)
+
+
+def test_sparse_mcxent_equals_one_hot_mcxent():
+    """LossSparseMCXENT analog: identical scores to mcxent on the one-hot
+    expansion, logits and probability paths, with and without masks."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.losses import mcxent, sparse_mcxent
+
+    rng = np.random.default_rng(0)
+    B, V = 6, 7
+    logits = jnp.asarray(rng.normal(size=(B, V)).astype(np.float32))
+    ids = rng.integers(0, V, B)
+    onehot = jnp.asarray(np.eye(V, dtype=np.float32)[ids])
+    idsj = jnp.asarray(ids)
+    mask = jnp.asarray((rng.random(B) > 0.3).astype(np.float32))
+    for kw in ({"from_logits": True}, {}):
+        out = (logits if kw else jax.nn.softmax(logits, axis=-1))
+        np.testing.assert_allclose(
+            np.asarray(sparse_mcxent(idsj, out, **kw)),
+            np.asarray(mcxent(onehot, out, **kw)), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse_mcxent(idsj, out, mask, **kw)),
+            np.asarray(mcxent(onehot, out, mask, **kw)), rtol=1e-5, atol=1e-6)
+
+
+def test_evaluation_accepts_sparse_labels():
+    """net-evaluation path for integer-id labels ([B] and masked [B, T])."""
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    ev = Evaluation()
+    preds = np.asarray([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    ev.eval(np.asarray([0, 1, 1]), preds)
+    assert ev.num_examples() == 3
+    assert abs(ev.accuracy() - 2 / 3) < 1e-9
+
+    ev2 = Evaluation()
+    seq_preds = np.zeros((2, 3, 4), np.float32)
+    seq_preds[..., 1] = 1.0                      # always predicts class 1
+    ids = np.asarray([[1, 1, 2], [1, 3, 0]])
+    mask = np.asarray([[1, 1, 0], [1, 0, 0]], np.float32)
+    ev2.eval(ids, seq_preds, mask)
+    assert ev2.num_examples() == 3               # masked steps dropped
+    assert abs(ev2.accuracy() - 3 / 3) < 1e-9 or ev2.accuracy() == 1.0
+
+
+def test_sparse_mcxent_rejects_one_hot():
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.ops.losses import sparse_mcxent
+
+    with _pytest.raises(ValueError, match="INDICES"):
+        sparse_mcxent(np.eye(4, dtype=np.float32),
+                      np.full((4, 4), 0.25, np.float32))
