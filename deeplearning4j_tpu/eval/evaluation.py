@@ -41,7 +41,13 @@ def _to_class_indices(a, n_classes=None):
     a = np.asarray(a)
     if a.ndim >= 2 and a.shape[-1] > 1:
         return np.argmax(a, axis=-1).reshape(-1)
-    return a.reshape(-1).astype(np.int64)
+    flat = a.reshape(-1)
+    if np.issubdtype(flat.dtype, np.floating) and flat.size \
+            and not np.all(flat == np.round(flat)):
+        # single-column PROBABILITIES (a sigmoid head): threshold at 0.5
+        # — int-casting would floor every p < 1.0 to class 0
+        return (flat >= 0.5).astype(np.int64)
+    return flat.astype(np.int64)
 
 
 class Evaluation:
@@ -59,6 +65,14 @@ class Evaluation:
         if self.cm is None:
             self.n_classes = self.n_classes or n
             self.cm = ConfusionMatrix(self.n_classes)
+        elif n > self.n_classes:
+            # sparse-label streams can reveal a larger id in a LATER batch
+            # (e.g. a [B,1] head whose first batch held only class 0):
+            # grow the matrix instead of crashing np.add.at
+            grown = ConfusionMatrix(n)
+            grown.matrix[:self.n_classes, :self.n_classes] = self.cm.matrix
+            self.cm = grown
+            self.n_classes = n
 
     def eval(self, labels, predictions, mask=None):
         """Accumulate a batch. labels: one-hot [B, C] (or [B, T, C]) OR
@@ -85,7 +99,11 @@ class Evaluation:
             m = np.asarray(mask).reshape(-1).astype(bool)
             labels, predictions = labels[m], predictions[m]
         if sparse:
-            n = predictions.shape[-1]
+            # size by the prediction head, but never smaller than the ids
+            # actually seen (a [B, 1] single-output head with 0/1 ids, or
+            # an off-by-one vocab, must not crash the confusion matrix)
+            n = int(max(predictions.shape[-1],
+                        labels.max() + 1 if labels.size else 1))
         elif labels.ndim >= 2:
             n = labels.shape[-1]
         else:

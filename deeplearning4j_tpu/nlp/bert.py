@@ -230,6 +230,7 @@ class BertIterator:
 
     def __iter__(self):
         rows, labs = [], []
+        yielded = 0
         for item in self.sentences:
             if isinstance(item, tuple):
                 text, lab = item
@@ -240,7 +241,21 @@ class BertIterator:
             rows.append(self._encode_one(text))
             labs.append(lab)
             if len(rows) == self.batch_size:
+                # arm the exhaustion guard BEFORE yielding: a consumer that
+                # breaks out mid-epoch (the steps-bounded pattern) closes
+                # this generator at the yield and the epilogue never runs
+                self._ever_yielded = True
+                yielded += 1
                 yield self._emit(rows, labs)
                 rows, labs = [], []
         if rows:
+            self._ever_yielded = True
+            yielded += 1
             yield self._emit(rows, labs)
+        if yielded == 0 and getattr(self, "_ever_yielded", False):
+            # a single-pass generator was exhausted on an earlier epoch —
+            # fail loud instead of letting a multi-epoch loop spin forever
+            raise ValueError(
+                "sentence provider yielded nothing after a non-empty "
+                "earlier pass; pass a list or a resettable iterator "
+                "(nlp.corpus) for multi-epoch training, not a generator")

@@ -335,7 +335,19 @@ class ComputationGraph:
         return train_step
 
     def fit_batch(self, ds) -> float:
-        x, y, mask = _unpack(ds)
+        x, y, mask, label_mask = _unpack(ds)
+        if label_mask is not None and label_mask is not mask and not (
+                np.shape(mask) == np.shape(label_mask)
+                and np.array_equal(np.asarray(mask),
+                                   np.asarray(label_mask))):
+            # equal masks are the ordinary RNN case and use the shared
+            # path; genuinely distinct masks (masked LM) are not yet
+            # threaded through the vertex mask list — fail loud
+            raise NotImplementedError(
+                "ComputationGraph.fit_batch does not yet thread a labels "
+                "mask DISTINCT from the features mask (the masked-LM "
+                "shape); use MultiDataSet per-output labels masks or a "
+                "MultiLayerNetwork")
         inputs = self._as_input_dict(x)
         if isinstance(y, dict):
             labels = {k: jnp.asarray(v) for k, v in y.items()}
@@ -380,12 +392,13 @@ class ComputationGraph:
     def evaluate(self, iterator, evaluation=None) -> Evaluation:
         ev = evaluation or Evaluation()
         for ds in iterator:
-            x, y, mask = _unpack(ds)
+            x, y, mask, label_mask = _unpack(ds)
             out = self.output(x)
             if isinstance(out, list):
                 out = out[0]
                 y = y[0] if isinstance(y, (list, tuple)) else y
-            ev.eval(np.asarray(y), np.asarray(out), mask=mask)
+            ev.eval(np.asarray(y), np.asarray(out),
+                    mask=label_mask if label_mask is not None else mask)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return ev

@@ -155,17 +155,21 @@ class MultiLayerNetwork:
         return acts
 
     # ---------------------------------------------------------------- output
-    def output(self, x, train: bool = False):
-        """Inference forward pass, jitted once per input shape."""
+    def output(self, x, train: bool = False, mask=None):
+        """Inference forward pass, jitted once per input shape. ``mask``:
+        optional [B, T] padding mask threaded to the layers (attention /
+        RNN padding — r4, so masked-LM/padded-batch EVAL attends exactly
+        like training does)."""
         x = jnp.asarray(x)
         fn = self._jit_cache.get("output")
         if fn is None:
             @jax.jit
-            def fn(params, state, x):
+            def fn(params, state, x, mask=None):
                 cp = _tree_cast(params, self._policy.compute_dtype)
                 cx = x if not jnp.issubdtype(x.dtype, jnp.floating) else x.astype(
                     self._policy.compute_dtype)
-                preout, _, mask, _ = self._forward(cp, state, cx, False, None, None)
+                preout, _, _, _ = self._forward(cp, state, cx, False, None,
+                                                mask)
                 out_layer = self.layers[-1]
                 if hasattr(out_layer, "preout"):
                     from deeplearning4j_tpu.nn.layers.base import resolve_activation
@@ -175,13 +179,17 @@ class MultiLayerNetwork:
                 return preout.astype(self._policy.output_dtype)
 
             self._jit_cache["output"] = fn
-        return fn(self.params, self.state, x)
+        return fn(self.params, self.state, x,
+                  None if mask is None else jnp.asarray(mask))
 
     # ------------------------------------------------------------------- fit
-    def _loss_terms(self, params, state, x, y, rng, mask, carries=None):
+    def _loss_terms(self, params, state, x, y, rng, mask, carries=None,
+                    label_mask=None):
         """Loss + aux from one forward. With ``carries`` (tBPTT) the RNN
         layers start from explicit carried state; returns
-        (loss, new_states, new_carries-or-None)."""
+        (loss, new_states, new_carries-or-None). ``label_mask``: a loss
+        mask DISTINCT from the forward mask (masked LM, r4) — the forward
+        sees ``mask`` (padding) while the loss covers ``label_mask``."""
         if carries is None:
             preout, new_states, out_mask, features = self._forward(
                 params, state, x, True, rng, mask)
@@ -189,6 +197,8 @@ class MultiLayerNetwork:
         else:
             preout, new_states, out_mask, features, new_carries = (
                 self._forward_carry(params, state, x, carries, True, rng, mask))
+        if label_mask is not None:
+            out_mask = label_mask
         out_layer = self.layers[-1]
         per = out_layer.score_from_preout(y, preout, out_mask)
         if isinstance(out_layer, CenterLossOutputLayer):
@@ -217,12 +227,14 @@ class MultiLayerNetwork:
 
     def _make_train_step(self):
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, state, opt_state, step, x, y, key, mask):
+        def train_step(params, state, opt_state, step, x, y, key, mask,
+                       label_mask=None):
             def loss_fn(p):
                 cp = _tree_cast(p, self._policy.compute_dtype)
                 cx = x if not jnp.issubdtype(x.dtype, jnp.floating) else x.astype(
                     self._policy.compute_dtype)
-                loss, new_states, _ = self._loss_terms(cp, state, cx, y, key, mask)
+                loss, new_states, _ = self._loss_terms(
+                    cp, state, cx, y, key, mask, label_mask=label_mask)
                 return loss.astype(jnp.float32), new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -269,13 +281,15 @@ class MultiLayerNetwork:
 
     def _make_tbptt_step(self):
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def step(params, state, opt_state, step_i, x, y, key, mask, carries):
+        def step(params, state, opt_state, step_i, x, y, key, mask, carries,
+                 label_mask=None):
             def loss_fn(p):
                 cp = _tree_cast(p, self._policy.compute_dtype)
                 cx = x if not jnp.issubdtype(x.dtype, jnp.floating) else x.astype(
                     self._policy.compute_dtype)
                 loss, new_states, new_carries = self._loss_terms(
-                    cp, state, cx, y, key, mask, carries=carries)
+                    cp, state, cx, y, key, mask, carries=carries,
+                    label_mask=label_mask)
                 return loss.astype(jnp.float32), (new_states, new_carries)
 
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
@@ -288,7 +302,7 @@ class MultiLayerNetwork:
 
         return step
 
-    def _fit_tbptt(self, x, y, mask) -> float:
+    def _fit_tbptt(self, x, y, mask, label_mask=None) -> float:
         L = self.conf.tbptt_fwd_length
         x, y = jnp.asarray(x), jnp.asarray(y)
         T = x.shape[1]
@@ -306,11 +320,13 @@ class MultiLayerNetwork:
         for s in starts:
             xc, yc = x[:, s:s + L], y[:, s:s + L]
             mc = None if mask is None else jnp.asarray(mask)[:, s:s + L]
+            lc = (None if label_mask is None
+                  else jnp.asarray(label_mask)[:, s:s + L])
             key = self._next_key()
             self.params, self.state, self.opt_state, loss, carries = step_fn(
                 self.params, self.state, self.opt_state,
                 jnp.asarray(self.step_count, jnp.int32), xc, yc, key, mc,
-                carries)
+                carries, lc)
             total += float(loss)
             n_chunks += 1
         self.score_value = total / max(n_chunks, 1)
@@ -366,10 +382,10 @@ class MultiLayerNetwork:
 
     def fit_batch(self, ds) -> float:
         """One optimization step on a DataSet/(features, labels) pair."""
-        x, y, mask = _unpack(ds)
+        x, y, mask, label_mask = _unpack(ds)
         if (self.conf.tbptt_fwd_length > 0 and np.ndim(x) == 3
                 and np.shape(x)[1] > self.conf.tbptt_fwd_length):
-            return self._fit_tbptt(x, y, mask)
+            return self._fit_tbptt(x, y, mask, label_mask)
         step_fn = self._jit_cache.get("train")
         if step_fn is None:
             step_fn = self._make_train_step()
@@ -379,6 +395,7 @@ class MultiLayerNetwork:
             self.params, self.state, self.opt_state,
             jnp.asarray(self.step_count, jnp.int32), jnp.asarray(x), jnp.asarray(y), key,
             None if mask is None else jnp.asarray(mask),
+            None if label_mask is None else jnp.asarray(label_mask),
         )
         self.score_value = float(loss)
         for lst in self.listeners:
@@ -507,26 +524,30 @@ class MultiLayerNetwork:
         """Loss on a dataset without updating (MultiLayerNetwork.score(DataSet))."""
         if ds is None:
             return self.score_value
-        x, y, mask = _unpack(ds)
+        x, y, mask, label_mask = _unpack(ds)
         fn = self._jit_cache.get("score")
         if fn is None:
             @jax.jit
-            def fn(params, state, x, y, mask):
+            def fn(params, state, x, y, mask, label_mask=None):
                 preout, _, out_mask, _ = self._forward(params, state, x, False, None, mask)
+                if label_mask is not None:
+                    out_mask = label_mask
                 per = self.layers[-1].score_from_preout(y, preout, out_mask)
                 return per.mean()
 
             self._jit_cache["score"] = fn
         return float(fn(self.params, self.state, jnp.asarray(x), jnp.asarray(y),
-                        None if mask is None else jnp.asarray(mask)))
+                        None if mask is None else jnp.asarray(mask),
+                        None if label_mask is None else jnp.asarray(label_mask)))
 
     # ------------------------------------------------------------------ eval
     def evaluate(self, iterator, evaluation=None) -> Evaluation:
         ev = evaluation or Evaluation()
         for ds in iterator:
-            x, y, mask = _unpack(ds)
-            out = self.output(x)
-            ev.eval(np.asarray(y), np.asarray(out), mask=mask)
+            x, y, mask, label_mask = _unpack(ds)
+            out = self.output(x, mask=mask)   # forward sees the padding mask
+            ev.eval(np.asarray(y), np.asarray(out),
+                    mask=label_mask if label_mask is not None else mask)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return ev
@@ -550,15 +571,28 @@ class MultiLayerNetwork:
 
 def _unpack(ds):
     """Accept DataSet/MultiDataSet-like (has .features/.labels), tuple,
-    or dict."""
+    or dict. Returns (features, labels, mask, label_mask).
+
+    ``mask`` is the FORWARD mask (attention/RNN padding; the features
+    mask); ``label_mask`` is non-None only when the DataSet carries a
+    labels mask DISTINCT from its features mask — the masked-LM shape
+    (r4), where the model must attend to all real tokens but the loss
+    covers only the selected positions (DL4J's separate featuresMask /
+    labelsMask semantics). A single mask keeps its r1-r3 behavior: it
+    plays both roles."""
     if hasattr(ds, "features"):
-        mask = getattr(ds, "labels_mask", None)
-        if mask is None:
-            mask = getattr(ds, "features_mask", None)
-        return ds.features, ds.labels, mask
+        fm = getattr(ds, "features_mask", None)
+        lm = getattr(ds, "labels_mask", None)
+        if fm is None:
+            return ds.features, ds.labels, lm, None
+        return ds.features, ds.labels, fm, lm
     if isinstance(ds, dict):
-        return ds["features"], ds["labels"], ds.get("mask")
-    if len(ds) == 3:
+        return (ds["features"], ds["labels"], ds.get("mask"),
+                ds.get("labels_mask"))
+    if len(ds) == 4:
         return ds
+    if len(ds) == 3:
+        x, y, m = ds
+        return x, y, m, None
     x, y = ds
-    return x, y, None
+    return x, y, None, None

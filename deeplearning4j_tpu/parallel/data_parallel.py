@@ -56,12 +56,15 @@ class ParallelWrapper:
             self._place()
         from deeplearning4j_tpu.nn.multilayer import _unpack
 
-        x, y, mask = _unpack(ds)
+        x, y, mask, label_mask = _unpack(ds)
         n = np.asarray(x).shape[0] if not isinstance(x, (list, tuple, dict)) else None
         dp = self.mesh.shape["data"]
         if n is not None and n % dp:
             raise ValueError(f"batch size {n} not divisible by data-parallel degree {dp}")
-        batch = self.mesh.shard_batch((x, y) if mask is None else (x, y, mask))
+        parts = (x, y) if mask is None else (x, y, mask)
+        if label_mask is not None:
+            parts = (x, y, mask, label_mask)
+        batch = self.mesh.shard_batch(parts)
         with self.mesh.mesh:
             return self.model.fit_batch(batch)
 

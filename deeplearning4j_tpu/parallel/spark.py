@@ -247,19 +247,24 @@ class _RebatchingIterator:
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.nn.multilayer import _unpack
 
-        feats, labels, masks = [], [], []
+        feats, labels, masks, lmasks = [], [], [], []
         have, any_mask, any_unmasked = 0, False, False
+        any_lmask, any_no_lmask = False, False
 
         def _cat(n):
             fx = np.concatenate(feats)
             fy = np.concatenate(labels)
             fm = np.concatenate(masks) if any_mask else None
+            lm = np.concatenate(lmasks) if any_lmask else None
             return (DataSet(fx[:n], fy[:n],
-                            None if fm is None else fm[:n]),
-                    fx[n:], fy[n:], None if fm is None else fm[n:])
+                            None if fm is None else fm[:n],
+                            None if lm is None else lm[:n]),
+                    fx[n:], fy[n:],
+                    None if fm is None else fm[n:],
+                    None if lm is None else lm[n:])
 
         for ds in self._source:
-            x, y, mask = _unpack(ds)
+            x, y, mask, lmask = _unpack(ds)
             feats.append(np.asarray(x))
             labels.append(np.asarray(y))
             if mask is not None:
@@ -267,18 +272,27 @@ class _RebatchingIterator:
                 masks.append(np.asarray(mask))
             else:
                 any_unmasked = True
+            if lmask is not None:
+                any_lmask = True
+                lmasks.append(np.asarray(lmask))
+            else:
+                any_no_lmask = True
+            if any_lmask and any_no_lmask:
+                raise ValueError("mixed labels-masked/unmasked DataSets "
+                                 "in one stream")
             if any_mask and any_unmasked:
                 raise ValueError("mixed masked/unmasked DataSets in one stream")
             have += feats[-1].shape[0]
             while have >= self._batch:
-                out, fx, fy, fm = _cat(self._batch)
+                out, fx, fy, fm, lm = _cat(self._batch)
                 yield out
                 feats, labels = [fx], [fy]
                 masks = [fm] if fm is not None else []
+                lmasks = [lm] if lm is not None else []
                 have = fx.shape[0]
         tail = (have // self._dp) * self._dp
         if tail:
-            out, _, _, _ = _cat(tail)
+            out, _, _, _, _ = _cat(tail)
             yield out
 
 

@@ -164,12 +164,15 @@ class TensorParallel:
             self.place()
         from deeplearning4j_tpu.nn.multilayer import _unpack
 
-        x, y, mask = _unpack(ds)
+        x, y, mask, label_mask = _unpack(ds)
         dp = self.mesh.shape["data"]
         n = np.asarray(x).shape[0]
         if n % max(dp, 1):
             raise ValueError(f"batch {n} not divisible by data axis {dp}")
-        batch = self.mesh.shard_batch((x, y) if mask is None else (x, y, mask))
+        parts = (x, y) if mask is None else (x, y, mask)
+        if label_mask is not None:
+            parts = (x, y, mask, label_mask)
+        batch = self.mesh.shard_batch(parts)
         with self.mesh.mesh:
             return self.model.fit_batch(batch)
 

@@ -1,0 +1,61 @@
+"""Masked-LM pretraining on a tiny WordPiece vocab — the BertIterator
+UNSUPERVISED task end to end (the reference's BertIterator +
+deeplearning4j-examples BERT pretraining shape): WordPiece tokenize ->
+80/10/10 corrupt -> transformer encoder -> sparse_mcxent over the masked
+positions only."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import BertIterator, BertWordPieceTokenizer
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (EmbeddingSequenceLayer,
+                                          RnnOutputLayer,
+                                          TransformerEncoderLayer)
+from deeplearning4j_tpu.nn.layers.attention import PositionalEmbeddingLayer
+from deeplearning4j_tpu.optimize import Adam
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "cat", "dog", "sat", "ran", "on", "mat", "rug", "park",
+         "play", "##ed", "##s", "and", "in", "a"]
+
+SENTENCES = ["the cat sat on the mat", "the dog sat on the rug",
+             "the dog ran in the park", "a cat and a dog played",
+             "the cats sat and the dogs ran"] * 8
+
+
+def main(steps: int = 60, max_len: int = 16, d_model: int = 32,
+         seed: int = 7):
+    tok = BertWordPieceTokenizer(VOCAB)
+    it = BertIterator(tok, SENTENCES, batch_size=16, max_len=max_len,
+                      task="unsupervised", mask_prob=0.15, seed=seed)
+    V = len(VOCAB)
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(lr=3e-3)).list()
+            .layer(EmbeddingSequenceLayer(n_in=V, n_out=d_model))
+            .layer(PositionalEmbeddingLayer(max_len=max_len))
+            .layer(TransformerEncoderLayer(d_model=d_model, n_heads=4,
+                                           d_ff=2 * d_model))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                  loss="sparse_mcxent"))
+            .set_input_type(InputType.recurrent(V, max_len)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    first = last = None
+    done = 0
+    while done < steps:
+        for ds in it:
+            net.fit_batch(ds)        # int-id labels, masked positions only
+            if first is None:
+                first = net.score_value
+            last = net.score_value
+            done += 1
+            if done >= steps:
+                break
+        it.reset()
+    return first, last
+
+
+if __name__ == "__main__":
+    f, l = main()
+    print(f"masked-LM loss: {f:.4f} -> {l:.4f}")

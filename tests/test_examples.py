@@ -81,3 +81,10 @@ def test_datavec_etl():
 
     acc = datavec_etl.main(epochs=20, n=240)
     assert acc > 0.85
+
+
+def test_bert_mlm():
+    import bert_mlm
+
+    first, last = bert_mlm.main(steps=40)
+    assert np.isfinite(last) and last < first

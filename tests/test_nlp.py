@@ -553,3 +553,84 @@ def test_sparse_mcxent_rejects_one_hot():
     with _pytest.raises(ValueError, match="INDICES"):
         sparse_mcxent(np.eye(4, dtype=np.float32),
                       np.full((4, 4), 0.25, np.float32))
+
+
+def test_mlm_dual_masks_route_correctly(monkeypatch):
+    """r4 regression: a masked-LM DataSet carries features_mask (padding)
+    AND labels_mask (selected positions). The FORWARD/attention must see
+    the padding mask — not the ~15% loss mask — while the loss covers only
+    the selected positions (DL4J's separate featuresMask/labelsMask)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import (EmbeddingSequenceLayer,
+                                              RnnOutputLayer,
+                                              TransformerEncoderLayer)
+    from deeplearning4j_tpu.optimize import Adam
+
+    V, T = 12, 8
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(Adam(lr=1e-3)).list()
+            .layer(EmbeddingSequenceLayer(n_in=V, n_out=8))
+            .layer(TransformerEncoderLayer(d_model=8, n_heads=2))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                  loss="sparse_mcxent"))
+            .set_input_type(InputType.recurrent(V, T)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, V, (4, T)).astype(np.int32)
+    fmask = np.ones((4, T), np.float32)
+    fmask[:, 6:] = 0                     # last 2 positions are padding
+    lmask = np.zeros((4, T), np.float32)
+    lmask[:, 2] = 1                      # loss over ONE selected position
+
+    import jax.numpy as _jnp
+
+    # reference computation with EXPLICIT routing: forward masked by the
+    # padding mask, loss masked by the labels mask
+    def manual(forward_mask, loss_mask):
+        preout, _, _, _ = net._forward(net.params, net.state,
+                                       _jnp.asarray(ids), False, None,
+                                       _jnp.asarray(forward_mask))
+        per = net.layers[-1].score_from_preout(
+            _jnp.asarray(ids), preout, _jnp.asarray(loss_mask))
+        return float(per.mean())
+
+    s_dual = net.score(DataSet(ids, ids.copy(), fmask, lmask))
+    assert abs(s_dual - manual(fmask, lmask)) < 1e-5
+    # the r4 bug being pinned: threading the labels mask into the FORWARD
+    # (attention over only the selected positions) gives a different loss
+    assert abs(s_dual - manual(lmask, lmask)) > 1e-4
+    # zeroing the labels mask zeroes the loss (loss covers only selected)
+    s_none = net.score(DataSet(ids, ids.copy(), fmask, np.zeros_like(lmask)))
+    assert s_dual > 0 and abs(s_none) < 1e-6, (s_dual, s_none)
+    # and training steps run under the dual-mask signature
+    net.fit_batch(DataSet(ids, ids.copy(), fmask, lmask))
+
+
+def test_bert_iterator_generator_exhaustion_fails_loud():
+    from deeplearning4j_tpu.nlp import BertIterator, BertWordPieceTokenizer
+
+    tok = BertWordPieceTokenizer(["[PAD]", "[UNK]", "[CLS]", "[SEP]",
+                                  "[MASK]", "the", "cat"])
+    gen = (s for s in ["the cat"] * 3)          # single-pass generator
+    it = BertIterator(tok, gen, batch_size=2, max_len=8,
+                      task="unsupervised")
+    assert len(list(it)) == 2                   # first pass works
+    with pytest.raises(ValueError, match="exhausted|resettable"):
+        list(it)                                # second pass fails loud
+
+
+def test_evaluation_matrix_grows_across_sparse_batches():
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    ev = Evaluation()
+    one_col = np.asarray([[0.2], [0.1]])        # single-output head
+    ev.eval(np.asarray([0, 0]), one_col)        # batch 1: only class 0
+    ev.eval(np.asarray([1, 0]), np.asarray([[0.8], [0.3]]))  # class 1 later
+    assert ev.num_examples() == 4
+    # 0.8 thresholds to predicted class 1; 0.2/0.1/0.3 to class 0
+    assert ev.accuracy() == 1.0

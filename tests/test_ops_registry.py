@@ -74,6 +74,8 @@ def test_losses_shapes(name):
     probs = probs / probs.sum(-1, keepdims=True)
     if name in ("hinge", "squaredhinge"):
         labels = jnp.sign(labels - 0.5)
+    elif name == "sparsemcxent":
+        labels = jnp.asarray(rng.integers(0, k, n))   # class INDICES
     score = get_loss(name)(labels, probs)
     assert score.shape == (n,)
     assert bool(jnp.all(jnp.isfinite(score)))
