@@ -86,21 +86,34 @@ class MultiDataSet:
     Reference analog: org.nd4j.linalg.dataset.MultiDataSet (features[],
     labels[], per-array masks). ``features``/``labels`` are lists ordered
     like the graph's network_inputs/network_outputs (or dicts keyed by
-    name). Sequence masks: the graph threads ONE shared [B, T] mask through
-    every vertex (the common case — all sequence inputs share timing), so
-    a single mask is accepted; per-output mask lists must collapse to one.
+    name). Sequence masks: the graph threads ONE shared [B, T] features
+    mask through every vertex (the common case — all sequence inputs share
+    timing). ``labels_mask`` may be a single [B, T] array (applied to every
+    output's loss), or a per-output list/dict (r5) — the graph routes each
+    output's loss through its own labels mask while the forward sees the
+    features mask (DL4J's labelsMaskArrays semantics).
     """
 
     features: "list | dict"
     labels: "list | dict"
     features_mask: Optional[np.ndarray] = None
-    labels_mask: Optional[np.ndarray] = None
+    labels_mask: "Optional[np.ndarray | list | dict]" = None
 
     def _arrays(self, x):
         return list(x.values()) if isinstance(x, dict) else list(x)
 
     def num_examples(self) -> int:
         return int(self._arrays(self.features)[0].shape[0])
+
+    @staticmethod
+    def _take_mask(m, idx):
+        if m is None:
+            return None
+        if isinstance(m, dict):
+            return {k: (None if v is None else v[idx]) for k, v in m.items()}
+        if isinstance(m, (list, tuple)):
+            return [None if v is None else v[idx] for v in m]
+        return m[idx]
 
     def shuffle(self, seed: Optional[int] = None) -> "MultiDataSet":
         rng = np.random.default_rng(seed)
@@ -114,7 +127,7 @@ class MultiDataSet:
         return MultiDataSet(
             take(self.features), take(self.labels),
             None if self.features_mask is None else self.features_mask[idx],
-            None if self.labels_mask is None else self.labels_mask[idx])
+            self._take_mask(self.labels_mask, idx))
 
     def batches(self, batch_size: int):
         """Iterate MultiDataSet minibatches (MultiDataSetIterator analog)."""
@@ -130,4 +143,4 @@ class MultiDataSet:
             yield MultiDataSet(
                 take(self.features), take(self.labels),
                 None if self.features_mask is None else self.features_mask[sl],
-                None if self.labels_mask is None else self.labels_mask[sl])
+                self._take_mask(self.labels_mask, sl))

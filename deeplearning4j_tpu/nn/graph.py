@@ -139,21 +139,34 @@ class ComputationGraph:
             xs = [xs]
         return {n: jnp.asarray(x) for n, x in zip(names, xs)}
 
+    def _cast_in(self, params, inputs):
+        """Mixed-precision cast shared by the train/score traces."""
+        cp = _tree_cast(params, self._policy.compute_dtype)
+        ci = {k: (v.astype(self._policy.compute_dtype)
+                  if jnp.issubdtype(v.dtype, jnp.floating) else v)
+              for k, v in inputs.items()}
+        return cp, ci
+
     # ---------------------------------------------------------------- output
-    def output(self, *xs):
+    def output(self, *xs, mask=None):
+        """Inference forward. ``mask``: optional [B, T] features/padding
+        mask threaded to every vertex (attention/RNNs must see padding at
+        inference exactly as in training)."""
         inputs = self._as_input_dict(xs[0] if len(xs) == 1 else list(xs))
         fn = self._jit_cache.get("output")
         if fn is None:
             @jax.jit
-            def fn(params, state, inputs):
+            def fn(params, state, inputs, masks=None):
                 cp = _tree_cast(params, self._policy.compute_dtype)
-                acts, _, _, _ = self._forward(cp, state, inputs, False, None)
+                acts, _, _, _ = self._forward(cp, state, inputs, False, None,
+                                              masks=masks)
                 outs = [acts[n].astype(self._policy.output_dtype)
                         for n in self.conf.network_outputs]
                 return outs
 
             self._jit_cache["output"] = fn
-        outs = fn(self.params, self.state, inputs)
+        outs = fn(self.params, self.state, inputs,
+                  None if mask is None else [jnp.asarray(mask)])
         return outs[0] if len(outs) == 1 else outs
 
     # --------------------------------------------------------- rnnTimeStep
@@ -266,38 +279,101 @@ class ComputationGraph:
         return loss_fn, (self.params, self.state)
 
     # ------------------------------------------------------------------- fit
-    def _loss(self, params, state, inputs, labels: dict, rng, masks):
+    def _loss(self, params, state, inputs, labels: dict, rng, masks,
+              labels_masks=None, train=True):
+        """``masks``: the FORWARD (features/padding) mask list the vertices
+        consume. ``labels_masks``: optional dict {output_name: [B, T] mask}
+        of loss masks DISTINCT from the forward mask — the masked-LM shape
+        (r5), mirroring MultiLayerNetwork._loss_terms' label_mask routing:
+        attention/RNNs see the padding mask while each output's loss covers
+        only its labels mask (DL4J ComputationGraph featuresMask/labelsMask
+        semantics)."""
         acts, new_state, preouts, out_feats = self._forward(
-            params, state, inputs, True, rng, masks=masks, want_preout=True)
+            params, state, inputs, train, rng, masks=masks, want_preout=True)
         from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
 
-        # one shared [B, T] sequence mask (the same list contract the
-        # vertices consume); per-output losses apply it exactly like
+        # the shared [B, T] sequence mask (the same list contract the
+        # vertices consume) is the default loss mask; a per-output entry in
+        # labels_masks overrides it. Losses apply it exactly like
         # MultiLayerNetwork._loss_terms — masked per-sample sums
-        # normalized by the total valid-step count
-        out_mask = masks[0] if masks else None
+        # normalized by that output's valid-step count
+        shared_mask = masks[0] if masks else None
         loss = 0.0
         for name in self.conf.network_outputs:
             v = self.conf.vertices[name]
+            explicit = (labels_masks is not None
+                        and labels_masks.get(name) is not None)
+            out_mask = labels_masks[name] if explicit else shared_mask
+            ref = preouts[name] if name in preouts else acts[name]
+            if explicit:
+                # validate/canonicalize the explicit mask ONCE, before
+                # branching on output kind: a 3D sequence head takes a
+                # [B, T] mask; every other rank (collapsed 2D heads, 4D
+                # conv heads) takes a per-example [B]/[B, 1] mask,
+                # canonicalized to [B]. Anything else fails loud here
+                # rather than as an opaque broadcast error inside the loss.
+                B = ref.shape[0]
+                if ref.ndim == 3:
+                    if out_mask.shape != (B, ref.shape[1]):
+                        raise ValueError(
+                            f"labels mask for output '{name}' has shape "
+                            f"{tuple(out_mask.shape)}; expected "
+                            f"({B}, {ref.shape[1]}) for output shape "
+                            f"{tuple(ref.shape)}")
+                else:
+                    if int(np.prod(out_mask.shape)) != B:
+                        raise ValueError(
+                            f"labels mask for output '{name}' has shape "
+                            f"{tuple(out_mask.shape)}, not per-example for "
+                            f"output shape {tuple(ref.shape)}")
+                    out_mask = out_mask.reshape(B)
+            elif (out_mask is not None and ref.ndim == 2
+                    and out_mask.ndim == 2 and out_mask.shape[1] != 1):
+                # time axis collapsed upstream (LastTimeStep): the shared
+                # [B, T] forward mask no longer applies to the per-example
+                # output head — drop it, as MLN does via feed_forward_mask
+                out_mask = None
+            per_example = explicit and ref.ndim != 3
             if name in preouts and hasattr(v.layer, "score_from_preout"):
-                per = v.layer.score_from_preout(labels[name], preouts[name],
-                                                out_mask)
+                per = v.layer.score_from_preout(
+                    labels[name], ref, None if per_example else out_mask)
+                if per_example:
+                    # canonical [B] weights apply AFTER the head's own
+                    # reduction, uniformly across head ranks
+                    per = per * out_mask
                 if isinstance(v.layer, CenterLossOutputLayer):
+                    # any per-example-compatible mask (explicit OR a shared
+                    # [B, 1] features mask) covers the center term and the
+                    # persisted center update — mirrors MLN._loss_terms
+                    cmask = None
+                    if (out_mask is not None
+                            and int(np.prod(out_mask.shape)) == ref.shape[0]):
+                        cmask = out_mask.reshape(ref.shape[0])
                     cscore, cstate = v.layer.center_score_and_state(
                         params.get(name, {}), state.get(name, {}),
-                        out_feats[name], labels[name])
+                        out_feats[name], labels[name], mask=cmask)
                     per = per + cscore
                     new_state[name] = cstate
-                if (out_mask is not None and per.ndim == 1
-                        and out_mask.ndim >= 2):
+                if out_mask is not None and per.ndim == 1:
+                    # masked per-sample sums normalized by valid count —
+                    # for a [B, T] sequence mask AND a per-example [B]/[B,1]
+                    # mask alike (the two must not normalize differently)
                     loss = loss + per.sum() / jnp.maximum(out_mask.sum(), 1.0)
                 else:
                     loss = loss + per.mean()
             else:
                 d = acts[name] - labels[name]
                 if out_mask is not None and d.ndim == 3:
+                    # [B, T] mask (shared or explicit — explicit is
+                    # validated to this shape) over a sequence output
                     w = out_mask[..., None]
-                    loss = loss + ((d * d) * w).sum() /                         jnp.maximum(w.sum() * d.shape[-1], 1.0)
+                    loss = loss + ((d * d) * w).sum() / jnp.maximum(
+                        w.sum() * float(d.shape[-1]), 1.0)
+                elif explicit:
+                    # canonical [B] per-example mask, any other rank
+                    w = out_mask.reshape(d.shape[0], *([1] * (d.ndim - 1)))
+                    loss = loss + ((d * d) * w).sum() / jnp.maximum(
+                        w.sum() * float(np.prod(d.shape[1:])), 1.0)
                 else:
                     loss = loss + (d * d).mean()
         for name, v in self.conf.vertices.items():
@@ -310,13 +386,12 @@ class ComputationGraph:
         max_norm = self.conf.max_grad_norm
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, state, opt_state, step, inputs, labels, key, masks):
+        def train_step(params, state, opt_state, step, inputs, labels, key, masks,
+                       labels_masks=None):
             def loss_fn(p):
-                cp = _tree_cast(p, self._policy.compute_dtype)
-                ci = {k: (v.astype(self._policy.compute_dtype)
-                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
-                      for k, v in inputs.items()}
-                loss, new_state = self._loss(cp, state, ci, labels, key, masks)
+                cp, ci = self._cast_in(p, inputs)
+                loss, new_state = self._loss(cp, state, ci, labels, key, masks,
+                                             labels_masks=labels_masks)
                 return loss.astype(jnp.float32), new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -334,26 +409,54 @@ class ComputationGraph:
 
         return train_step
 
+    def _as_label_dict(self, y):
+        if isinstance(y, dict):
+            return {k: jnp.asarray(v) for k, v in y.items()}
+        ys = y if isinstance(y, (list, tuple)) else [y]
+        return {n: jnp.asarray(v)
+                for n, v in zip(self.conf.network_outputs, ys)}
+
+    def _labels_masks_for(self, mask, label_mask):
+        """Normalize a DataSet/MultiDataSet labels mask to the per-output
+        dict `_loss` consumes, or None when it adds nothing beyond the
+        shared forward mask (the ordinary RNN case — keeps the r1-r4
+        single-mask trace). Accepts a single [B, T] array (applied to
+        every output), or a per-output list/dict."""
+        if label_mask is None:
+            return None
+        outs = self.conf.network_outputs
+        if isinstance(label_mask, dict):
+            unknown = set(label_mask) - set(outs)
+            if unknown:
+                raise ValueError(
+                    f"labels_mask keys {sorted(unknown)} are not network "
+                    f"outputs {list(outs)}")
+            d = {k: jnp.asarray(v) for k, v in label_mask.items()
+                 if v is not None}
+        elif isinstance(label_mask, (list, tuple)):
+            if len(label_mask) != len(outs):
+                raise ValueError(
+                    f"labels_mask list has {len(label_mask)} entries for "
+                    f"{len(outs)} network outputs {list(outs)}")
+            d = {n: jnp.asarray(v) for n, v in zip(outs, label_mask)
+                 if v is not None}
+        else:
+            if label_mask is mask or (
+                    mask is not None
+                    and np.shape(mask) == np.shape(label_mask)
+                    and np.array_equal(np.asarray(mask),
+                                       np.asarray(label_mask))):
+                # identical to the forward mask: the shared path already
+                # covers it
+                return None
+            d = {n: jnp.asarray(label_mask) for n in outs}
+        return d or None
+
     def fit_batch(self, ds) -> float:
         x, y, mask, label_mask = _unpack(ds)
-        if label_mask is not None and label_mask is not mask and not (
-                np.shape(mask) == np.shape(label_mask)
-                and np.array_equal(np.asarray(mask),
-                                   np.asarray(label_mask))):
-            # equal masks are the ordinary RNN case and use the shared
-            # path; genuinely distinct masks (masked LM) are not yet
-            # threaded through the vertex mask list — fail loud
-            raise NotImplementedError(
-                "ComputationGraph.fit_batch does not yet thread a labels "
-                "mask DISTINCT from the features mask (the masked-LM "
-                "shape); use MultiDataSet per-output labels masks or a "
-                "MultiLayerNetwork")
         inputs = self._as_input_dict(x)
-        if isinstance(y, dict):
-            labels = {k: jnp.asarray(v) for k, v in y.items()}
-        else:
-            ys = y if isinstance(y, (list, tuple)) else [y]
-            labels = {n: jnp.asarray(v) for n, v in zip(self.conf.network_outputs, ys)}
+        labels = self._as_label_dict(y)
+        labels_masks = self._labels_masks_for(mask, label_mask)
         fn = self._jit_cache.get("train")
         if fn is None:
             fn = self._make_train_step()
@@ -364,7 +467,7 @@ class ComputationGraph:
         self.params, self.state, self.opt_state, loss = fn(
             self.params, self.state, self.opt_state,
             jnp.asarray(self.step_count, jnp.int32), inputs, labels, self._next_key(),
-            None if mask is None else [jnp.asarray(mask)])
+            None if mask is None else [jnp.asarray(mask)], labels_masks)
         self.score_value = float(loss)
         for lst in self.listeners:
             lst.iteration_done(self, self.step_count, self.epoch_count, self.score_value)
@@ -393,18 +496,44 @@ class ComputationGraph:
         ev = evaluation or Evaluation()
         for ds in iterator:
             x, y, mask, label_mask = _unpack(ds)
-            out = self.output(x)
+            out = self.output(x, mask=mask)  # forward sees the padding mask
             if isinstance(out, list):
                 out = out[0]
                 y = y[0] if isinstance(y, (list, tuple)) else y
+            # only the FIRST output is evaluated; validate the per-output
+            # list/dict exactly like fit_batch, then pick that output's mask
+            lms = self._labels_masks_for(mask, label_mask)
+            lm = None if lms is None else lms.get(self.conf.network_outputs[0])
             ev.eval(np.asarray(y), np.asarray(out),
-                    mask=label_mask if label_mask is not None else mask)
+                    mask=lm if lm is not None else mask)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return ev
 
     def score(self, ds=None) -> float:
-        return self.score_value
+        """Loss on a batch without updating (ComputationGraph.score(DataSet));
+        with no argument, the last fit score. Routes masks exactly like
+        fit_batch: forward sees the features mask, each output's loss its
+        labels mask."""
+        if ds is None:
+            return self.score_value
+        x, y, mask, label_mask = _unpack(ds)
+        inputs = self._as_input_dict(x)
+        labels = self._as_label_dict(y)
+        labels_masks = self._labels_masks_for(mask, label_mask)
+        fn = self._jit_cache.get("score")
+        if fn is None:
+            @jax.jit
+            def fn(params, state, inputs, labels, masks, labels_masks=None):
+                cp, ci = self._cast_in(params, inputs)
+                loss, _ = self._loss(cp, state, ci, labels, None, masks,
+                                     labels_masks=labels_masks, train=False)
+                return loss.astype(jnp.float32)
+
+            self._jit_cache["score"] = fn
+        return float(fn(self.params, self.state, inputs, labels,
+                        None if mask is None else [jnp.asarray(mask)],
+                        labels_masks))
 
     # ----------------------------------------------------------------- serde
     def save(self, path: str, save_updater: bool = True):

@@ -134,14 +134,21 @@ class CenterLossOutputLayer(OutputLayer):
         nin = self.n_in or itype.size
         return p, {"centers": jnp.zeros((self.n_out, nin))}
 
-    def center_score_and_state(self, params, state, features, labels):
+    def center_score_and_state(self, params, state, features, labels,
+                               mask=None):
+        """``mask``: optional per-example [B] weights (r5) — a masked-out
+        example contributes neither to the center-distance score nor to
+        the persisted center update."""
         centers = state["centers"]
         cls = jnp.argmax(labels, axis=-1)
         diff = features - centers[cls]
         score = 0.5 * self.alpha * (diff * diff).sum(axis=-1)
+        lw = labels if mask is None else labels * mask[:, None]
+        if mask is not None:
+            score = score * mask
         # center update: c_j += lambda * mean_{i: y_i=j}(f_i - c_j)
-        counts = labels.sum(axis=0)[:, None] + 1.0
-        delta = (labels.T @ features - counts * centers + centers) / counts
+        counts = lw.sum(axis=0)[:, None] + 1.0
+        delta = (lw.T @ features - counts * centers + centers) / counts
         new_centers = centers + self.lambda_ * delta
         return score, {"centers": new_centers}
 

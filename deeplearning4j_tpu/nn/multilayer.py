@@ -184,7 +184,7 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def _loss_terms(self, params, state, x, y, rng, mask, carries=None,
-                    label_mask=None):
+                    label_mask=None, train=True):
         """Loss + aux from one forward. With ``carries`` (tBPTT) the RNN
         layers start from explicit carried state; returns
         (loss, new_states, new_carries-or-None). ``label_mask``: a loss
@@ -192,7 +192,7 @@ class MultiLayerNetwork:
         sees ``mask`` (padding) while the loss covers ``label_mask``."""
         if carries is None:
             preout, new_states, out_mask, features = self._forward(
-                params, state, x, True, rng, mask)
+                params, state, x, train, rng, mask)
             new_carries = None
         else:
             preout, new_states, out_mask, features, new_carries = (
@@ -202,11 +202,20 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         per = out_layer.score_from_preout(y, preout, out_mask)
         if isinstance(out_layer, CenterLossOutputLayer):
+            # a per-example loss mask must cover the center term and the
+            # persisted center update too (r5)
+            cmask = None
+            if (out_mask is not None
+                    and int(np.prod(out_mask.shape)) == preout.shape[0]):
+                cmask = out_mask.reshape(preout.shape[0])
             cscore, cstate = out_layer.center_score_and_state(
-                params[-1], state[-1], features, y)
+                params[-1], state[-1], features, y, mask=cmask)
             per = per + cscore
             new_states[-1] = cstate
-        if out_mask is not None and per.ndim == 1 and out_mask.ndim >= 2:
+        if out_mask is not None and per.ndim == 1:
+            # masked per-sample sums normalized by valid count — a 1-D [B]
+            # per-example mask normalizes exactly like [B, 1]/[B, T] (r5;
+            # matches ComputationGraph._loss)
             denom = jnp.maximum(out_mask.sum(), 1.0)
             loss = per.sum() / denom
         else:
@@ -383,6 +392,7 @@ class MultiLayerNetwork:
     def fit_batch(self, ds) -> float:
         """One optimization step on a DataSet/(features, labels) pair."""
         x, y, mask, label_mask = _unpack(ds)
+        label_mask = _single_mask(label_mask)
         if (self.conf.tbptt_fwd_length > 0 and np.ndim(x) == 3
                 and np.shape(x)[1] > self.conf.tbptt_fwd_length):
             return self._fit_tbptt(x, y, mask, label_mask)
@@ -525,15 +535,18 @@ class MultiLayerNetwork:
         if ds is None:
             return self.score_value
         x, y, mask, label_mask = _unpack(ds)
+        label_mask = _single_mask(label_mask)
         fn = self._jit_cache.get("score")
         if fn is None:
             @jax.jit
             def fn(params, state, x, y, mask, label_mask=None):
-                preout, _, out_mask, _ = self._forward(params, state, x, False, None, mask)
-                if label_mask is not None:
-                    out_mask = label_mask
-                per = self.layers[-1].score_from_preout(y, preout, out_mask)
-                return per.mean()
+                # the SAME loss (mask normalization, center term,
+                # regularization) the fit path reports, minus the update —
+                # score and fit must not disagree on masked batches (r5)
+                loss, _, _ = self._loss_terms(
+                    params, state, x, y, None, mask,
+                    label_mask=label_mask, train=False)
+                return loss
 
             self._jit_cache["score"] = fn
         return float(fn(self.params, self.state, jnp.asarray(x), jnp.asarray(y),
@@ -545,6 +558,7 @@ class MultiLayerNetwork:
         ev = evaluation or Evaluation()
         for ds in iterator:
             x, y, mask, label_mask = _unpack(ds)
+            label_mask = _single_mask(label_mask)
             out = self.output(x, mask=mask)   # forward sees the padding mask
             ev.eval(np.asarray(y), np.asarray(out),
                     mask=label_mask if label_mask is not None else mask)
@@ -569,6 +583,18 @@ class MultiLayerNetwork:
         return self
 
 
+def _single_mask(lm):
+    """MultiLayerNetwork has ONE output: a per-output list/dict labels mask
+    (the r5 MultiDataSet/ComputationGraph shape) must fail loud here rather
+    than be jnp.asarray-stacked into a bogus [n, B, T] loss mask."""
+    if isinstance(lm, (list, tuple, dict)):
+        raise ValueError(
+            "per-output labels masks (list/dict) are a ComputationGraph/"
+            "MultiDataSet shape; MultiLayerNetwork takes a single labels "
+            "mask array")
+    return lm
+
+
 def _unpack(ds):
     """Accept DataSet/MultiDataSet-like (has .features/.labels), tuple,
     or dict. Returns (features, labels, mask, label_mask).
@@ -584,6 +610,11 @@ def _unpack(ds):
         fm = getattr(ds, "features_mask", None)
         lm = getattr(ds, "labels_mask", None)
         if fm is None:
+            # a single labels-mask array keeps its r1-r3 dual role (shared
+            # forward + loss mask); a per-output list/dict (r5, MultiDataSet)
+            # can only ever be a loss mask
+            if isinstance(lm, (list, tuple, dict)):
+                return ds.features, ds.labels, None, lm
             return ds.features, ds.labels, lm, None
         return ds.features, ds.labels, fm, lm
     if isinstance(ds, dict):

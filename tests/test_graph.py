@@ -289,3 +289,434 @@ class TestMultiDataSet:
         mln = MultiLayerNetwork(mln_conf).init()
         l3 = mln.fit_batch((x, y, mask))
         assert l1 == pytest.approx(l3, rel=1e-5), (l1, l3)
+
+
+class TestGraphDualMasks:
+    """r5: DISTINCT features/labels masks on the graph model type — the
+    masked-LM shape. Forward/attention must see the padding (features)
+    mask while each output's loss covers only its labels mask (DL4J
+    ComputationGraph featuresMaskArrays/labelsMaskArrays semantics;
+    removes the r4 NotImplementedError at ComputationGraph.fit_batch)."""
+
+    V, T = 12, 8
+
+    def _mlm_graph(self, seed=2):
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingSequenceLayer, RnnOutputLayer, TransformerEncoderLayer)
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(lr=1e-3)).graph_builder()
+                .add_inputs("ids")
+                .set_input_types(ids=InputType.recurrent(self.V, self.T))
+                .add_layer("emb", EmbeddingSequenceLayer(n_in=self.V, n_out=8),
+                           "ids")
+                .add_layer("enc", TransformerEncoderLayer(d_model=8, n_heads=2),
+                           "emb")
+                .add_layer("out", RnnOutputLayer(n_out=self.V,
+                                                 activation="softmax",
+                                                 loss="sparse_mcxent"), "enc")
+                .set_outputs("out")
+                .build())
+        return ComputationGraph(conf).init()
+
+    def _mlm_batch(self):
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, self.V, (4, self.T)).astype(np.int32)
+        fmask = np.ones((4, self.T), np.float32)
+        fmask[:, 6:] = 0                    # last 2 positions are padding
+        lmask = np.zeros((4, self.T), np.float32)
+        lmask[:, 2] = 1                     # loss over ONE selected position
+        return ids, fmask, lmask
+
+    def test_mlm_dual_masks_route_correctly_cg(self):
+        """CG twin of the r4 MLN regression: attention sees the padding
+        mask, not the ~15% loss mask."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets import DataSet
+
+        m = self._mlm_graph()
+        ids, fmask, lmask = self._mlm_batch()
+
+        # reference computation with EXPLICIT routing: forward masked by
+        # forward_mask, loss masked (and valid-count normalized, matching
+        # ComputationGraph._loss) by loss_mask
+        def manual(forward_mask, loss_mask):
+            _, _, preouts, _ = m._forward(
+                m.params, m.state, {"ids": jnp.asarray(ids)}, False, None,
+                masks=[jnp.asarray(forward_mask)], want_preout=True)
+            per = m.conf.vertices["out"].layer.score_from_preout(
+                jnp.asarray(ids), preouts["out"], jnp.asarray(loss_mask))
+            return float(per.sum() / max(float(loss_mask.sum()), 1.0))
+
+        s_dual = m.score(DataSet(ids, ids.copy(), fmask, lmask))
+        assert abs(s_dual - manual(fmask, lmask)) < 1e-5
+        # the pinned bug shape: routing the labels mask into the FORWARD
+        # (attending only to selected positions) gives a different loss
+        assert abs(s_dual - manual(lmask, lmask)) > 1e-4
+        # zeroing the labels mask zeroes the loss
+        s_none = m.score(DataSet(ids, ids.copy(), fmask,
+                                 np.zeros_like(lmask)))
+        assert s_dual > 0 and abs(s_none) < 1e-6, (s_dual, s_none)
+        # and training steps run under the dual-mask signature
+        loss = m.fit_batch(DataSet(ids, ids.copy(), fmask, lmask))
+        assert np.isfinite(loss)
+
+    def test_mlm_loss_parity_cg_vs_mln(self):
+        """The same masked-LM net as MLN and CG, params copied across:
+        identical first-step training loss (VERDICT r4 'done' criterion)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingSequenceLayer, RnnOutputLayer, TransformerEncoderLayer)
+
+        mln_conf = (NeuralNetConfiguration.builder().seed(2)
+                    .updater(Adam(lr=1e-3)).list()
+                    .layer(EmbeddingSequenceLayer(n_in=self.V, n_out=8))
+                    .layer(TransformerEncoderLayer(d_model=8, n_heads=2))
+                    .layer(RnnOutputLayer(n_out=self.V, activation="softmax",
+                                          loss="sparse_mcxent"))
+                    .set_input_type(InputType.recurrent(self.V, self.T))
+                    .build())
+        mln = MultiLayerNetwork(mln_conf).init()
+        cg = self._mlm_graph()
+        # deep-copy: CG's donated train step must not delete MLN's buffers
+        copy = lambda t: jax.tree_util.tree_map(
+            lambda v: jnp.array(np.asarray(v)), t)
+        for name, p, s in zip(["emb", "enc", "out"], mln.params, mln.state):
+            if p:
+                cg.params[name] = copy(p)
+            if s:
+                cg.state[name] = copy(s)
+
+        ids, fmask, lmask = self._mlm_batch()
+        l_cg = cg.fit_batch(DataSet(ids, ids.copy(), fmask, lmask))
+        l_mln = mln.fit_batch(DataSet(ids, ids.copy(), fmask, lmask))
+        assert l_cg == pytest.approx(l_mln, rel=1e-5), (l_cg, l_mln)
+
+    def test_multidataset_per_output_labels_masks(self):
+        """Each output's loss sees only ITS labels mask: garbage labels at
+        an output's masked-out steps leave the loss unchanged; garbage at
+        a valid step changes it."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.layers import (GravesLSTMLayer,
+                                                  RnnOutputLayer)
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().seed(3)
+                    .updater(Adam(lr=1e-3)).graph_builder()
+                    .add_inputs("seq")
+                    .set_input_types(seq=InputType.recurrent(2, None))
+                    .add_layer("lstm", GravesLSTMLayer(n_out=8,
+                                                       activation="tanh"),
+                               "seq")
+                    .add_layer("out1", RnnOutputLayer(n_out=2,
+                                                      activation="softmax",
+                                                      loss="mcxent"), "lstm")
+                    .add_layer("out2", RnnOutputLayer(n_out=3,
+                                                      activation="softmax",
+                                                      loss="mcxent"), "lstm")
+                    .set_outputs("out1", "out2")
+                    .build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(4, 6, 2)).astype(np.float32)
+        y1 = np.zeros((4, 6, 2), np.float32)
+        y1[..., 0] = 1.0
+        y2 = np.zeros((4, 6, 3), np.float32)
+        y2[..., 1] = 1.0
+        fm = np.ones((4, 6), np.float32)
+        m1 = np.ones((4, 6), np.float32)
+        m1[:, 3:] = 0.0                      # out1 loss: first 3 steps only
+        m2 = np.ones((4, 6), np.float32)
+        m2[:, 5:] = 0.0                      # out2 loss: first 5 steps
+
+        base = build().fit_batch(MultiDataSet(
+            [x], [y1, y2], features_mask=fm, labels_mask=[m1, m2]))
+        y1_garbage = y1.copy()
+        y1_garbage[:, 3:] = 9.0              # only steps m1 masks OUT
+        same = build().fit_batch(MultiDataSet(
+            [x], [y1_garbage, y2], features_mask=fm, labels_mask=[m1, m2]))
+        assert base == pytest.approx(same, rel=1e-6), (base, same)
+        y1_bad = y1.copy()
+        y1_bad[:, 1] = 9.0                   # a step m1 keeps
+        diff = build().fit_batch(MultiDataSet(
+            [x], [y1_bad, y2], features_mask=fm, labels_mask=[m1, m2]))
+        assert abs(diff - base) > 1e-4, (diff, base)
+
+    def test_multidataset_mask_list_survives_shuffle_and_batches(self):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        y1 = x * 2
+        y2 = x * 3
+        m1 = (x > 2).astype(np.float32)
+        m2 = (x > 4).astype(np.float32)
+        ds = MultiDataSet([x], [y1, y2], labels_mask=[m1, m2])
+        sh = ds.shuffle(seed=0)
+        assert np.array_equal(sh.labels_mask[0],
+                              (sh.features[0] > 2).astype(np.float32))
+        assert np.array_equal(sh.labels_mask[1],
+                              (sh.features[0] > 4).astype(np.float32))
+        parts = list(ds.batches(3))
+        assert [p.labels_mask[0].shape[0] for p in parts] == [3, 3, 2]
+        assert np.array_equal(parts[1].labels_mask[1], m2[3:6])
+
+    def test_mask_list_without_features_mask_is_loss_only(self):
+        """Code-review r5 regression: a per-output labels_mask list with NO
+        features_mask must reach the LOSS (not be fed to vertices as a
+        stacked forward mask)."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.layers import (GravesLSTMLayer,
+                                                  RnnOutputLayer)
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().seed(4)
+                    .updater(Adam(lr=1e-3)).graph_builder()
+                    .add_inputs("seq")
+                    .set_input_types(seq=InputType.recurrent(2, None))
+                    .add_layer("lstm", GravesLSTMLayer(n_out=6,
+                                                       activation="tanh"),
+                               "seq")
+                    .add_layer("out", RnnOutputLayer(n_out=2,
+                                                     activation="softmax",
+                                                     loss="mcxent"), "lstm")
+                    .set_outputs("out")
+                    .build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(4, 5, 2)).astype(np.float32)
+        y = np.zeros((4, 5, 2), np.float32)
+        y[..., 0] = 1.0
+        m = np.ones((4, 5), np.float32)
+        m[:, 3:] = 0.0
+        y_g = y.copy()
+        y_g[:, 3:] = 9.0                    # garbage only at masked-out steps
+        la = build().fit_batch(MultiDataSet([x], [y], labels_mask=[m]))
+        lb = build().fit_batch(MultiDataSet([x], [y_g], labels_mask=[m]))
+        assert la == pytest.approx(lb, rel=1e-6), (la, lb)
+        # evaluate() picks the first output's mask out of the list
+        ev = build().evaluate([MultiDataSet([x], [y], labels_mask=[m])])
+        assert ev.num_examples() == 12      # 4 rows x 3 valid steps
+
+    def test_mismatched_labels_mask_fails_loud(self):
+        """Unknown dict keys / wrong list length must raise, not silently
+        fall back to the shared mask (code-review r5)."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+
+        m = ComputationGraph(_residual_conf()).init()
+        x = np.zeros((2, 8), np.float32)
+        y = np.zeros((2, 3), np.float32)
+        mk = np.ones((2, 1), np.float32)
+        with pytest.raises(ValueError, match="not network outputs"):
+            m.fit_batch(MultiDataSet([x], [y], labels_mask={"nope": mk}))
+        with pytest.raises(ValueError, match="entries for"):
+            m.fit_batch(MultiDataSet([x], [y], labels_mask=[mk, mk]))
+
+    def test_output_and_evaluate_see_features_mask(self):
+        """Code-review r5: evaluate()'s forward must see the padding mask
+        (parity with fit/score routing and with MLN.evaluate)."""
+        from deeplearning4j_tpu.datasets import DataSet
+
+        m = self._mlm_graph()
+        ids, fmask, lmask = self._mlm_batch()
+        unmasked = np.asarray(m.output(ids))
+        masked = np.asarray(m.output(ids, mask=fmask))
+        # attention over padding changes predictions at VALID positions
+        assert not np.allclose(unmasked[:, :6], masked[:, :6], atol=1e-6)
+        ev = m.evaluate([DataSet(ids, ids.copy(), fmask, lmask)])
+        assert ev.num_examples() == int(lmask.sum())
+
+    def test_mln_rejects_per_output_mask_shapes(self):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.layers import (DenseLayer as _D,
+                                                  OutputLayer as _O)
+
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(lr=1e-3)).list()
+                .layer(_D(n_out=4, activation="relu"))
+                .layer(_O(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.zeros((2, 3), np.float32)
+        y = np.eye(2, dtype=np.float32)
+        mk = np.ones((2, 1), np.float32)
+        with pytest.raises(ValueError, match="single labels mask"):
+            net.fit_batch(MultiDataSet([x], [y], labels_mask=[mk]))
+        with pytest.raises(ValueError, match="single labels mask"):
+            net.score(MultiDataSet([x], [y], labels_mask={"o": mk}))
+
+    def test_shared_mask_skipped_for_time_collapsed_output(self):
+        """Code-review r5 regression: a seq-to-vector graph (LastTimeStep)
+        with a shared features mask must keep training — the shared mask is
+        dropped for the collapsed 2D output, exactly the pre-r5 behavior."""
+        from deeplearning4j_tpu.nn.layers import (LastTimeStepLayer,
+                                                  LSTMLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(6)
+                .updater(Adam(lr=1e-3)).graph_builder()
+                .add_inputs("seq")
+                .set_input_types(seq=InputType.recurrent(3, 5))
+                .add_layer("l", LastTimeStepLayer(underlying=LSTMLayer(n_out=6)),
+                           "seq")
+                .add_layer("d", DenseLayer(n_out=2, activation="identity"),
+                           "l")
+                .set_outputs("d")
+                .build())
+        m = ComputationGraph(conf).init()
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(4, 5, 3)).astype(np.float32)
+        y = rng.normal(size=(4, 2)).astype(np.float32)
+        mk = np.ones((4, 5), np.float32)
+        mk[:, 3:] = 0.0
+        loss = m.fit_batch({"features": x, "labels": y, "mask": mk})
+        assert np.isfinite(loss)
+        # but an EXPLICIT per-output mask of the wrong shape fails loud
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        with pytest.raises(ValueError, match="per-example"):
+            m.fit_batch(MultiDataSet([x], [y], features_mask=mk,
+                                     labels_mask=[mk]))
+        # and an explicit per-example mask works: garbage on a masked-out
+        # example leaves the loss unchanged
+        exm = np.asarray([[1.0], [1.0], [1.0], [0.0]], np.float32)
+        y_g = y.copy()
+        y_g[3] = 99.0
+        la = ComputationGraph(conf).init().fit_batch(
+            MultiDataSet([x], [y], features_mask=mk, labels_mask=[exm]))
+        lb = ComputationGraph(conf).init().fit_batch(
+            MultiDataSet([x], [y_g], features_mask=mk, labels_mask=[exm]))
+        assert la == pytest.approx(lb, rel=1e-6), (la, lb)
+
+    def test_classifier_head_drops_collapsed_shared_mask(self):
+        """Code-review r5: seq-to-vector CLASSIFIER head (score_from_preout
+        path) with a shared [B, T] features mask must train — the mask is
+        dropped once the time axis is collapsed, like MLN feed_forward_mask."""
+        from deeplearning4j_tpu.nn.layers import (LastTimeStepLayer,
+                                                  LSTMLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(6)
+                .updater(Adam(lr=1e-3)).graph_builder()
+                .add_inputs("seq")
+                .set_input_types(seq=InputType.recurrent(3, 5))
+                .add_layer("l", LastTimeStepLayer(underlying=LSTMLayer(n_out=6)),
+                           "seq")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "l")
+                .set_outputs("out")
+                .build())
+        m = ComputationGraph(conf).init()
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(4, 5, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        mk = np.ones((4, 5), np.float32)
+        mk[:, 3:] = 0.0
+        loss = m.fit_batch({"features": x, "labels": y, "mask": mk})
+        assert np.isfinite(loss)
+
+    def test_per_example_mask_B_and_B1_score_identically(self):
+        """Code-review r5: an explicit per-example labels mask must
+        normalize the same whether shaped [B] or [B, 1]."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+        mb = np.asarray([1, 1, 0, 0], np.float32)
+
+        def score_with(mask):
+            return ComputationGraph(_residual_conf()).init().score(
+                MultiDataSet([x], [y], labels_mask=[mask]))
+
+        s_flat = score_with(mb)
+        s_col = score_with(mb.reshape(4, 1))
+        s_all = ComputationGraph(_residual_conf()).init().score(
+            MultiDataSet([x], [y]))
+        assert s_flat == pytest.approx(s_col, abs=1e-6), (s_flat, s_col)
+        assert abs(s_flat - s_all) > 1e-6   # the mask does something
+
+    def test_explicit_mask_shape_validated_on_all_output_kinds(self):
+        """Code-review r5: explicit labels-mask shape is validated ONCE for
+        every output kind — sequence heads take [B, T]; collapsed heads
+        take per-example — instead of opaque broadcast errors / silent
+        T-factor loss inflation on the unguarded branches."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.layers import (LastTimeStepLayer,
+                                                  LSTMLayer, RnnOutputLayer)
+
+        rng = np.random.default_rng(9)
+        # sequence classifier head: per-example [B, 1] mask must fail loud
+        seq_conf = (NeuralNetConfiguration.builder().seed(2)
+                    .updater(Adam(lr=1e-3)).graph_builder()
+                    .add_inputs("seq")
+                    .set_input_types(seq=InputType.recurrent(3, 5))
+                    .add_layer("l", LSTMLayer(n_out=6), "seq")
+                    .add_layer("out", RnnOutputLayer(n_out=2,
+                                                     activation="softmax",
+                                                     loss="mcxent"), "l")
+                    .set_outputs("out").build())
+        x = rng.normal(size=(4, 5, 3)).astype(np.float32)
+        y = np.zeros((4, 5, 2), np.float32)
+        y[..., 0] = 1.0
+        with pytest.raises(ValueError, match="expected"):
+            ComputationGraph(seq_conf).init().fit_batch(
+                MultiDataSet([x], [y], labels_mask=[np.ones((4, 1),
+                                                            np.float32)]))
+        # collapsed classifier head: [B, T] explicit mask must fail loud
+        col_conf = (NeuralNetConfiguration.builder().seed(2)
+                    .updater(Adam(lr=1e-3)).graph_builder()
+                    .add_inputs("seq")
+                    .set_input_types(seq=InputType.recurrent(3, 5))
+                    .add_layer("l",
+                               LastTimeStepLayer(underlying=LSTMLayer(n_out=6)),
+                               "seq")
+                    .add_layer("out", OutputLayer(n_out=2,
+                                                  activation="softmax",
+                                                  loss="mcxent"), "l")
+                    .set_outputs("out").build())
+        yc = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        with pytest.raises(ValueError, match="per-example"):
+            ComputationGraph(col_conf).init().fit_batch(
+                MultiDataSet([x], [yc],
+                             labels_mask=[np.ones((4, 5), np.float32)]))
+
+    def test_center_loss_head_respects_per_example_mask(self):
+        """Code-review r5: the center-loss term AND the persisted center
+        update must exclude masked-out examples."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+
+        def build():
+            conf = (NeuralNetConfiguration.builder().seed(5)
+                    .updater(Adam(lr=1e-2)).graph_builder()
+                    .add_inputs("in")
+                    .set_input_types(**{"in": InputType.feed_forward(6)})
+                    .add_layer("fc", DenseLayer(n_out=4, activation="relu"),
+                               "in")
+                    .add_layer("out",
+                               CenterLossOutputLayer(n_out=3,
+                                                     activation="softmax",
+                                                     loss="mcxent"), "fc")
+                    .set_outputs("out")
+                    .build())
+            return ComputationGraph(conf).init()
+
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(6, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 6)]
+        mk = np.asarray([1, 1, 1, 1, 0, 0], np.float32)
+        x_g, y_g = x.copy(), y.copy()
+        x_g[4:] = 50.0                      # garbage at masked-out examples
+        y_g[4:] = np.eye(3, dtype=np.float32)[0]
+        ma = build()
+        la = ma.fit_batch(MultiDataSet([x], [y], labels_mask=[mk]))
+        mb = build()
+        lb = mb.fit_batch(MultiDataSet([x_g], [y_g], labels_mask=[mk]))
+        assert la == pytest.approx(lb, rel=1e-5), (la, lb)
+        np.testing.assert_allclose(
+            np.asarray(ma.state["out"]["centers"]),
+            np.asarray(mb.state["out"]["centers"]), rtol=1e-5)
