@@ -741,17 +741,37 @@ def bench_kernels(rounds=3, budget_deadline=None):
     return table
 
 
+def _smoke_max_rel_err(a, b):
+    """max |a - b| / max|b| across the (possibly multi-array) outputs."""
+    import jax
+    import numpy as np
+
+    worst = 0.0
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        xa = np.asarray(jax.device_get(la), np.float32)
+        xb = np.asarray(jax.device_get(lb), np.float32)
+        denom = max(float(np.max(np.abs(xb))), 1e-6)
+        worst = max(worst, float(np.max(np.abs(xa - xb))) / denom)
+    return worst
+
+
 def bench_smoke(budget_deadline=None):
-    """Mosaic-compile (not time) every Pallas kernel at a minimal selected
-    shape on the real chip; report pass/fail per kernel (VERDICT r3 #6).
+    """Mosaic-compile AND numerically verify every Pallas kernel at a
+    minimal selected shape on the real chip (VERDICT r3 #6 + r4 weak #2).
 
     The default test suite runs kernels through the CPU interpreter, so a
     jax/libtpu upgrade that breaks Mosaic COMPILATION would otherwise only
-    surface as a perf-table failure late in a bench run. This block is
-    cheap (compile-only, served by the persistent cache on repeat runs),
-    runs first, and survives deadline truncation — cold-cache compiles are
-    bounded by a per-case deadline check so the block can never eat the
-    north-star line's budget."""
+    surface as a perf-table failure late in a bench run — and a Mosaic
+    MISCOMPILE producing wrong values would not surface at all (the A/B
+    table measures time only). r5: after each compile the kernel RUNS at
+    the same shape and is allclose-checked against its XLA lowering —
+    per-kernel {ok, compile_s, max_rel_err, tol}, mirroring the reference's
+    cuDNN-parity tests (same layer with and without the helper, assert
+    allclose). A deliberate-perturbation self-test proves the comparator
+    can fail. The block is cheap (compiles served by the persistent cache
+    on repeat runs; the shapes are small), runs first, and survives
+    deadline truncation."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -766,73 +786,125 @@ def bench_smoke(budget_deadline=None):
                            dtype=dtype)
 
     def cases():
+        """(name, kernel_thunk, xla_ref_thunk, rel_tol) per kernel. The
+        reference is the registered XLA lowering the registry would select
+        with the kernel demoted — identical math, different engine. bf16
+        flash rows tolerate ~3e-2 (accumulation-order differences in half
+        precision); f32 RNN/LRN rows sit at 1e-3/1e-4."""
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+        from deeplearning4j_tpu.ops.convolution import lrn as xla_lrn
         from deeplearning4j_tpu.ops.pallas.flash_attention import flash_attention
         from deeplearning4j_tpu.ops.pallas.fused_gru import fused_gru_layer
         from deeplearning4j_tpu.ops.pallas.fused_lstm import fused_lstm_layer
         from deeplearning4j_tpu.ops.pallas.lrn import pallas_lrn
+        from deeplearning4j_tpu.ops.recurrent import gru_layer, lstm_layer
 
         q64 = r(1, 1, 2048, 64, dtype=jnp.bfloat16)
         q128 = r(1, 1, 2048, 128, dtype=jnp.bfloat16)
         km = jnp.ones((1, 2048), jnp.float32)
-        yield "flash_fwd_d64", lambda: flash_attention(q64, q64, q64)
-        yield "flash_fwd_d128_causal", lambda: flash_attention(
-            q128, q128, q128, causal=True)
-        yield "flash_fwd_masked", lambda: flash_attention(
-            q64, q64, q64, mask=km)
-        yield "flash_bwd_d64", lambda: jax.grad(
-            lambda q: flash_attention(q, q, q).astype(jnp.float32).sum())(q64)
-        yield "flash_bwd_masked", lambda: jax.grad(
-            lambda q: flash_attention(q, q, q, mask=km).astype(
-                jnp.float32).sum())(q64)
+        km4 = km[:, None, None, :]
+
+        def fa(attn, q, **kw):
+            return lambda: attn(q, q, q, **kw).astype(jnp.float32)
+
+        def fa_g(attn, q, **kw):
+            return lambda: jax.grad(
+                lambda qq: attn(qq, qq, qq, **kw).astype(
+                    jnp.float32).sum())(q).astype(jnp.float32)
+
+        yield ("flash_fwd_d64", fa(flash_attention, q64),
+               fa(dot_product_attention, q64), 3e-2)
+        yield ("flash_fwd_d128_causal", fa(flash_attention, q128, causal=True),
+               fa(dot_product_attention, q128, causal=True), 3e-2)
+        yield ("flash_fwd_masked", fa(flash_attention, q64, mask=km),
+               fa(dot_product_attention, q64, mask=km4), 3e-2)
+        yield ("flash_bwd_d64", fa_g(flash_attention, q64),
+               fa_g(dot_product_attention, q64), 3e-2)
+        yield ("flash_bwd_masked", fa_g(flash_attention, q64, mask=km),
+               fa_g(dot_product_attention, q64, mask=km4), 3e-2)
+
+        def rnn(fn, args):
+            return lambda: fn(*args)[0]
+
+        def rnn_g(fn, args, wi):
+            def thunk():
+                def loss(W):
+                    a = list(args)
+                    a[wi] = W
+                    return fn(*a)[0].sum()
+                return jax.grad(loss)(args[wi])
+            return thunk
 
         x = r(8, 4, 32)
         h0 = jnp.zeros((8, 256))
         Wl, Rl, bl = r(32, 1024), r(256, 1024), jnp.zeros((1024,))
-        yield "lstm_fwd", lambda: fused_lstm_layer(x, h0, h0, Wl, Rl, bl)[0]
-        yield "lstm_bwd", lambda: jax.grad(
-            lambda W: fused_lstm_layer(x, h0, h0, W, Rl, bl)[0].sum())(Wl)
+        la = (x, h0, h0, Wl, Rl, bl)
+        yield ("lstm_fwd", rnn(fused_lstm_layer, la), rnn(lstm_layer, la),
+               1e-3)
+        yield ("lstm_bwd", rnn_g(fused_lstm_layer, la, 3),
+               rnn_g(lstm_layer, la, 3), 1e-3)
         Wg, Rg, bg = r(32, 768), r(256, 768), jnp.zeros((768,))
-        yield "gru_fwd", lambda: fused_gru_layer(x, h0, Wg, Rg, bg)[0]
-        yield "gru_bwd", lambda: jax.grad(
-            lambda W: fused_gru_layer(x, h0, W, Rg, bg)[0].sum())(Wg)
+        ga = (x, h0, Wg, Rg, bg)
+        yield ("gru_fwd", rnn(fused_gru_layer, ga), rnn(gru_layer, ga), 1e-3)
+        yield ("gru_bwd", rnn_g(fused_gru_layer, ga, 2),
+               rnn_g(gru_layer, ga, 2), 1e-3)
 
         # r4 batch-blocked plans (nb > 1): B=256/H=1024 compiles the
-        # fwd Bc=32/64 and bwd (64,512) grids at T=2 (compile-only check;
-        # the timed A/B runs the real T=64 shape)
+        # fwd Bc=32/64 and bwd (64,512) grids at T=2 (the timed A/B runs
+        # the real T=64 shape); r5 also value-checks them
         xb = r(256, 2, 64)
         hb0 = jnp.zeros((256, 1024))
         Wb, Rb, bb = r(64, 4096), r(1024, 4096), jnp.zeros((4096,))
-        yield "lstm_fwd_batchblocked", lambda: fused_lstm_layer(
-            xb, hb0, hb0, Wb, Rb, bb)[0]
-        yield "lstm_bwd_batchblocked", lambda: jax.grad(
-            lambda W: fused_lstm_layer(xb, hb0, hb0, W, Rb, bb)[0].sum())(Wb)
+        ba = (xb, hb0, hb0, Wb, Rb, bb)
+        yield ("lstm_fwd_batchblocked", rnn(fused_lstm_layer, ba),
+               rnn(lstm_layer, ba), 1e-3)
+        yield ("lstm_bwd_batchblocked", rnn_g(fused_lstm_layer, ba, 3),
+               rnn_g(lstm_layer, ba, 3), 1e-3)
         Wbg, Rbg, bbg = r(64, 3072), r(1024, 3072), jnp.zeros((3072,))
-        yield "gru_fwd_batchblocked", lambda: fused_gru_layer(
-            xb, hb0, Wbg, Rbg, bbg)[0]
-        yield "gru_bwd_batchblocked", lambda: jax.grad(
-            lambda W: fused_gru_layer(xb, hb0, W, Rbg, bbg)[0].sum())(Wbg)
+        bg_a = (xb, hb0, Wbg, Rbg, bbg)
+        yield ("gru_fwd_batchblocked", rnn(fused_gru_layer, bg_a),
+               rnn(gru_layer, bg_a), 1e-3)
+        yield ("gru_bwd_batchblocked", rnn_g(fused_gru_layer, bg_a, 2),
+               rnn_g(gru_layer, bg_a, 2), 1e-3)
 
         xl = r(4, 32, 32, 64)
-        yield "lrn_fwd", lambda: pallas_lrn(xl)
-        yield "lrn_bwd", lambda: jax.grad(
-            lambda a: (pallas_lrn(a) ** 2).sum())(xl)
+        yield ("lrn_fwd", lambda: pallas_lrn(xl), lambda: xla_lrn(xl), 1e-4)
+        yield ("lrn_bwd",
+               lambda: jax.grad(lambda a: (pallas_lrn(a) ** 2).sum())(xl),
+               lambda: jax.grad(lambda a: (xla_lrn(a) ** 2).sum())(xl), 1e-4)
 
     out = {}
-    for name, thunk in cases():
+    for name, thunk, ref, tol in cases():
         if (budget_deadline is not None
                 and time.perf_counter() > budget_deadline):
             out["truncated"] = "deadline reached; remaining compiles skipped"
             break
         t0 = time.perf_counter()
         try:
-            jax.jit(thunk).lower().compile()
-            out[name] = {"ok": True,
-                         "compile_s": round(time.perf_counter() - t0, 2)}
+            ex = jax.jit(thunk).lower().compile()
+            compile_s = round(time.perf_counter() - t0, 2)
+            # run the SAME compiled executable for the value check (a bare
+            # jit re-dispatch would compile a second time)
+            err = _smoke_max_rel_err(ex(), jax.jit(ref)())
+            out[name] = {"ok": bool(err <= tol), "compile_s": compile_s,
+                         "max_rel_err": float(f"{err:.3g}"), "tol": tol}
         except Exception as e:
             out[name] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    # the comparator must be able to FAIL: a deliberately perturbed
+    # "kernel" (+1e-3 on every element) against the same reference has to
+    # exceed the tightest tolerance, or the numeric verdicts above are
+    # meaningless
+    try:
+        base = jnp.asarray(rng.normal(size=(128, 128)).astype(np.float32))
+        err = _smoke_max_rel_err(base + 1e-3, base)
+        out["harness_selftest"] = {
+            "ok": bool(err > 1e-4),
+            "perturbation_detected_rel_err": float(f"{err:.3g}")}
+    except Exception as e:  # pragma: no cover
+        out["harness_selftest"] = {"ok": False, "error": str(e)[:200]}
     compiled = [v for v in out.values() if isinstance(v, dict) and "ok" in v]
     # all_ok asserts a COMPLETE green pass: an empty/truncated run is not
-    # evidence that the kernels compile
+    # evidence that the kernels compile and agree with XLA
     out["all_ok"] = (bool(compiled) and "truncated" not in out
                      and all(v["ok"] for v in compiled))
     return out

@@ -238,39 +238,34 @@ class ComputationGraph:
         self._rnn_carries = None
 
     def as_loss_fn(self, train: bool = False):
-        """(loss_fn(params, state, rng, x, y) -> (loss, new_state),
-        (initial params, initial state)) — the functional surface the
-        parallel trainers consume (the ComputationGraph counterpart of
-        MultiLayerNetwork.as_loss_fn).
+        """(loss_fn(params, state, rng, x, y, mask=None, label_mask=None)
+        -> (loss, new_state), (initial params, initial state)) — the
+        functional surface the parallel trainers consume (the
+        ComputationGraph counterpart of MultiLayerNetwork.as_loss_fn).
 
         x: one array for single-input graphs or a {input_name: array}
         dict; y likewise for the graph's outputs. r4: network state (BN
         running stats) and the dropout rng are threaded through instead
         of frozen at export time, and l1/l2 regularization terms are
-        included — matching the fit path."""
+        included — matching the fit path. r5: routes through _loss itself,
+        so the fit path's mask semantics (forward sees ``mask``, each
+        output's loss covers ``label_mask``, valid-count normalization)
+        hold on the functional surface too."""
         conf = self.conf
 
-        def loss_fn(params, state, rng, x, y):
-            from deeplearning4j_tpu.nn.conf.graph import LayerVertex
-
+        def loss_fn(params, state, rng, x, y, mask=None, label_mask=None,
+                    denom=None):
             inputs = self._as_input_dict(x)
             labels = y if isinstance(y, dict) else \
                 {conf.network_outputs[0]: y}
-            acts, new_state, preouts, _ = self._forward(
-                params, state, inputs, train, rng, want_preout=True)
-            loss = 0.0
-            for name in conf.network_outputs:
-                v = conf.vertices[name]
-                if name in preouts and hasattr(v.layer,
-                                               "score_from_preout"):
-                    loss = loss + v.layer.score_from_preout(
-                        labels[name], preouts[name], None).mean()
-                else:
-                    d = acts[name] - labels[name]
-                    loss = loss + (d * d).mean()
-            for name, v in conf.vertices.items():
-                if isinstance(v, LayerVertex) and name in params:
-                    loss = loss + v.layer.regularization(params[name])
+            masks = None if mask is None else [mask]
+            # trace-safe: no host-side mask-equality fast path here — the
+            # caller passes label_mask only when it is genuinely distinct
+            lms = (None if label_mask is None
+                   else {n: label_mask for n in conf.network_outputs})
+            loss, new_state = self._loss(params, state, inputs, labels,
+                                         rng, masks, labels_masks=lms,
+                                         train=train, denom=denom)
             # vertices with no state entry keep their old (empty) state so
             # the returned tree matches the input's structure
             merged = {k: new_state.get(k, s) for k, s in state.items()}
@@ -280,7 +275,7 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------- fit
     def _loss(self, params, state, inputs, labels: dict, rng, masks,
-              labels_masks=None, train=True):
+              labels_masks=None, train=True, denom=None):
         """``masks``: the FORWARD (features/padding) mask list the vertices
         consume. ``labels_masks``: optional dict {output_name: [B, T] mask}
         of loss masks DISTINCT from the forward mask — the masked-LM shape
@@ -357,8 +352,12 @@ class ComputationGraph:
                 if out_mask is not None and per.ndim == 1:
                     # masked per-sample sums normalized by valid count —
                     # for a [B, T] sequence mask AND a per-example [B]/[B,1]
-                    # mask alike (the two must not normalize differently)
-                    loss = loss + per.sum() / jnp.maximum(out_mask.sum(), 1.0)
+                    # mask alike (the two must not normalize differently).
+                    # ``denom`` (r5): trainer-supplied global_valid/dp
+                    # override, see MultiLayerNetwork._loss_terms
+                    d = (denom if denom is not None
+                         else jnp.maximum(out_mask.sum(), 1.0))
+                    loss = loss + per.sum() / d
                 else:
                     loss = loss + per.mean()
             else:
@@ -367,13 +366,15 @@ class ComputationGraph:
                     # [B, T] mask (shared or explicit — explicit is
                     # validated to this shape) over a sequence output
                     w = out_mask[..., None]
+                    nv = w.sum() if denom is None else denom
                     loss = loss + ((d * d) * w).sum() / jnp.maximum(
-                        w.sum() * float(d.shape[-1]), 1.0)
+                        nv * float(d.shape[-1]), 1.0)
                 elif explicit:
                     # canonical [B] per-example mask, any other rank
                     w = out_mask.reshape(d.shape[0], *([1] * (d.ndim - 1)))
+                    nv = w.sum() if denom is None else denom
                     loss = loss + ((d * d) * w).sum() / jnp.maximum(
-                        w.sum() * float(np.prod(d.shape[1:])), 1.0)
+                        nv * float(np.prod(d.shape[1:])), 1.0)
                 else:
                     loss = loss + (d * d).mean()
         for name, v in self.conf.vertices.items():

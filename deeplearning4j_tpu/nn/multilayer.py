@@ -184,12 +184,16 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def _loss_terms(self, params, state, x, y, rng, mask, carries=None,
-                    label_mask=None, train=True):
+                    label_mask=None, train=True, denom=None):
         """Loss + aux from one forward. With ``carries`` (tBPTT) the RNN
         layers start from explicit carried state; returns
         (loss, new_states, new_carries-or-None). ``label_mask``: a loss
         mask DISTINCT from the forward mask (masked LM, r4) — the forward
-        sees ``mask`` (padding) while the loss covers ``label_mask``."""
+        sees ``mask`` (padding) while the loss covers ``label_mask``.
+        ``denom`` (r5): overrides the masked-sum normalizer (local valid
+        count) — the data-parallel trainers pass global_valid/dp so that a
+        mean over replicas reproduces the GLOBAL-batch loss exactly even
+        when padding is distributed unevenly across shards."""
         if carries is None:
             preout, new_states, out_mask, features = self._forward(
                 params, state, x, train, rng, mask)
@@ -216,8 +220,9 @@ class MultiLayerNetwork:
             # masked per-sample sums normalized by valid count — a 1-D [B]
             # per-example mask normalizes exactly like [B, 1]/[B, T] (r5;
             # matches ComputationGraph._loss)
-            denom = jnp.maximum(out_mask.sum(), 1.0)
-            loss = per.sum() / denom
+            d = denom if denom is not None else jnp.maximum(out_mask.sum(),
+                                                            1.0)
+            loss = per.sum() / d
         else:
             loss = per.mean()
         reg = sum(l.regularization(p) for l, p in zip(self.layers, params))
@@ -504,11 +509,11 @@ class MultiLayerNetwork:
         return float(loss)
 
     def as_loss_fn(self, train: bool = False):
-        """(loss_fn(params, state, rng, x, y) -> (loss, new_state),
-        (initial params, initial state)) — the functional surface the
-        parallel trainers consume (ParameterAveragingTrainer /
-        EncodedGradientTrainer take a loss over plain TREES, not a model
-        object).
+        """(loss_fn(params, state, rng, x, y, mask=None, label_mask=None)
+        -> (loss, new_state), (initial params, initial state)) — the
+        functional surface the parallel trainers consume
+        (ParameterAveragingTrainer / EncodedGradientTrainer take a loss
+        over plain TREES, not a model object).
 
         r4: network state (BN running stats) and the dropout rng are
         THREADED through the surface instead of frozen at export time, so
@@ -517,15 +522,21 @@ class MultiLayerNetwork:
         running stats included. l1/l2 regularization terms are included,
         matching the fit path. train=True runs train-mode forward (batch
         statistics in BN, dropout when ``rng`` is not None); rng=None
-        disables dropout."""
-        layers = self.layers
+        disables dropout.
 
-        def loss_fn(params, state, rng, x, y):
-            preout, new_states, out_mask, _ = self._forward(
-                params, state, x, train, rng, None)
-            per = layers[-1].score_from_preout(y, preout, out_mask)
-            reg = sum(l.regularization(p) for l, p in zip(layers, params))
-            return per.mean() + reg, new_states
+        r5: optional trailing (mask, label_mask) — the fit path's mask
+        routing on the functional surface: the forward sees ``mask``
+        (padding), the loss covers ``label_mask`` (or ``mask`` when no
+        distinct labels mask), normalized by the valid-step count. This is
+        _loss_terms itself, so padded-sequence models train identically
+        here and under fit_batch."""
+
+        def loss_fn(params, state, rng, x, y, mask=None, label_mask=None,
+                    denom=None):
+            loss, new_states, _ = self._loss_terms(
+                params, state, x, y, rng, mask, label_mask=label_mask,
+                train=train, denom=denom)
+            return loss, new_states
 
         return loss_fn, (self.params, self.state)
 

@@ -66,6 +66,7 @@ class ParameterAveragingTrainer:
         self.average_updater_state = average_updater_state
         self.stateful = stateful
         self._round = None
+        self._round_keys = None
 
     def init(self, params, state=None, rng=None):
         n = self.mesh.shape[self.axis]
@@ -76,6 +77,7 @@ class ParameterAveragingTrainer:
 
         opt = self.updater.init_state(params)
         self._round = None  # re-init invalidates the cached compiled round
+        self._round_keys = None
         carry = {"params": rep(params), "opt": rep(opt),
                  "step": jnp.asarray(0, jnp.int32)}
         if self.stateful:
@@ -84,11 +86,13 @@ class ParameterAveragingTrainer:
             carry["rng"] = jax.random.key_data(key)
         return carry
 
-    def _build(self, carry):
+    def _build(self, carry, batch_keys):
         loss_fn, updater = self.loss_fn, self.updater
         axis = self.axis
         avg_opt = self.average_updater_state
         stateful = self.stateful
+        has_mask = "mask" in batch_keys
+        has_lmask = "label_mask" in batch_keys
 
         def avg_state_leaf(t):
             # running stats (floats) are averaged at sync, like the
@@ -99,9 +103,11 @@ class ParameterAveragingTrainer:
                 return lax.pmean(t, axis)
             return t
 
-        def round_fn(carry, xs, ys):
+        def round_fn(carry, batch):
             """One averaging round: K purely-local steps, then ONE pmean.
-            xs/ys: [K, local_batch, ...] — K microbatches for this replica."""
+            batch: dict of [K, local_batch, ...] arrays — K microbatches
+            for this replica ("x"/"y" always; "mask"/"label_mask" (r5)
+            when the stream carries them)."""
             params = jax.tree_util.tree_map(lambda t: t[0], carry["params"])
             opt = jax.tree_util.tree_map(lambda t: t[0], carry["opt"])
             if stateful:
@@ -109,15 +115,20 @@ class ParameterAveragingTrainer:
                                                     carry["state"])
                 round_key = jax.random.wrap_key_data(carry["rng"])
 
-            def local_step(state, batch):
-                x, y = batch
+            def local_step(state, mb):
+                x, y = mb["x"], mb["y"]
                 if stateful:
                     p, o, s, i = state
                     k = jax.random.fold_in(
                         jax.random.fold_in(round_key, i),
                         lax.axis_index(axis))
+                    extra, kw = (), {}
+                    if has_mask or has_lmask:
+                        extra = (mb.get("mask"), mb.get("label_mask"))
+                    if "denom" in mb:
+                        kw["denom"] = mb["denom"]
                     (loss, s2), g = jax.value_and_grad(
-                        loss_fn, has_aux=True)(p, s, k, x, y)
+                        loss_fn, has_aux=True)(p, s, k, x, y, *extra, **kw)
                 else:
                     p, o, i = state
                     loss, g = jax.value_and_grad(loss_fn)(p, x, y)
@@ -130,10 +141,10 @@ class ParameterAveragingTrainer:
             if stateful:
                 (params, opt, net_state, step), losses = lax.scan(
                     local_step, (params, opt, net_state0, carry["step"]),
-                    (xs, ys))
+                    batch)
             else:
                 (params, opt, step), losses = lax.scan(
-                    local_step, (params, opt, carry["step"]), (xs, ys))
+                    local_step, (params, opt, carry["step"]), batch)
             # the round's single collective: average the diverged replicas
             params = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), params)
             if avg_opt:
@@ -159,33 +170,69 @@ class ParameterAveragingTrainer:
             spec_rep["state"] = jax.tree_util.tree_map(lambda _: P(axis),
                                                        carry["state"])
             spec_rep["rng"] = P()
+        batch_specs = {k: (P(None) if k == "denom" else P(None, axis))
+                       for k in batch_keys}
         fn = shard_map(
             round_fn, mesh=self.mesh,
-            in_specs=(spec_rep, P(None, axis), P(None, axis)),
+            in_specs=(spec_rep, batch_specs),
             out_specs=(spec_rep, P()),
+            # the model loss may route through Pallas kernels (fused
+            # LSTM/GRU, flash attention), whose calls don't carry vma
+            # metadata — same decision as parallel/sequence.py
+            check_vma=False,
         )
         return jax.jit(fn)
 
-    def fit_round(self, carry, x, y):
+    def fit_round(self, carry, x, y, mask=None, label_mask=None):
         """One full averaging round over a global batch.
 
         x/y: [K * global_batch, ...] — split into K sequential microbatches;
         each replica sees K local shards, steps K times locally, then the
-        single parameter average runs. Returns (carry, mean loss)."""
-        if self._round is None:
-            self._round = self._build(carry)
-        x, y = jnp.asarray(x), jnp.asarray(y)
+        single parameter average runs. ``mask``/``label_mask`` (r5):
+        optional [K * global_batch, T] masks riding the same split — the
+        stateful as_loss_fn surface normalizes each local step by its
+        shard's valid count. Returns (carry, mean loss)."""
+        import numpy as np
+
+        if (mask is not None or label_mask is not None) and not self.stateful:
+            raise ValueError(
+                "masked batches need stateful=True (the as_loss_fn surface "
+                "that takes (mask, label_mask))")
         K = self.freq
-        if x.shape[0] % K:
-            raise ValueError(f"batch {x.shape[0]} not divisible into "
-                             f"{K} local steps")
         dp = self.mesh.shape[self.axis]
-        if (x.shape[0] // K) % dp:
-            raise ValueError(f"per-step batch {x.shape[0] // K} not "
+        denom = None
+        if K == 1 and (mask is not None or label_mask is not None):
+            # K=1 IS sync DP: each replica normalizes its shard's summed
+            # loss by global_valid/dp so the post-step parameter mean
+            # equals one global-batch step EXACTLY, padding distribution
+            # notwithstanding. K>1 keeps local-valid normalization — each
+            # worker's local step is its own fit step (the reference's
+            # per-worker minibatch semantics). Computed from the incoming
+            # host arrays BEFORE device placement (no device round-trip).
+            nm = np.asarray(label_mask if label_mask is not None else mask)
+            denom = jnp.asarray(
+                np.maximum(nm.reshape(K, -1).sum(axis=1), 1.0) / dp,
+                jnp.float32)
+        batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+        if mask is not None:
+            batch["mask"] = jnp.asarray(mask)
+        if label_mask is not None:
+            batch["label_mask"] = jnp.asarray(label_mask)
+        n = batch["x"].shape[0]
+        if n % K:
+            raise ValueError(f"batch {n} not divisible into {K} local steps")
+        if (n // K) % dp:
+            raise ValueError(f"per-step batch {n // K} not "
                              f"divisible by data-parallel degree {dp}")
-        xs = x.reshape((K, x.shape[0] // K) + x.shape[1:])
-        ys = y.reshape((K, y.shape[0] // K) + y.shape[1:])
-        return self._round(carry, xs, ys)
+        batch = {k: v.reshape((K, n // K) + v.shape[1:])
+                 for k, v in batch.items()}
+        if denom is not None:
+            batch["denom"] = denom
+        keys = frozenset(batch)
+        if self._round is None or self._round_keys != keys:
+            self._round = self._build(carry, keys)
+            self._round_keys = keys
+        return self._round(carry, batch)
 
     def params(self, carry):
         """The (replica-identical) averaged params as a plain tree."""

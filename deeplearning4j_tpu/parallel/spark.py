@@ -111,6 +111,7 @@ class SparkDl4jMultiLayer:
 
         import numpy as np
 
+        from deeplearning4j_tpu.nn.multilayer import _unpack
         from deeplearning4j_tpu.parallel.param_averaging import (
             ParameterAveragingTrainer,
         )
@@ -129,7 +130,7 @@ class SparkDl4jMultiLayer:
         # than K batches per epoch — rounds must still complete, exactly
         # like the reference master carrying its iteration count across
         # RDD passes)
-        xs, ys, have = [], [], 0
+        xs, ys, ms, lms, have = [], [], [], [], 0
         dropped_tail = 0
         for _ in range(epochs):
             for ds in _RebatchingIterator(data, global_batch, dp):
@@ -139,21 +140,27 @@ class SparkDl4jMultiLayer:
                     # round, so it is dropped (counted + warned below)
                     dropped_tail += ds.features.shape[0]
                     continue
-                if getattr(ds, "features_mask", None) is not None or \
-                        getattr(ds, "mask", None) is not None:
-                    raise NotImplementedError(
-                        "masked DataSets are not supported on the "
-                        "averaging_frequency>1 path (the functional loss "
-                        "has no mask normalization); use "
-                        "averaging_frequency=1 or the ParallelWrapper")
-                xs.append(np.asarray(ds.features))
-                ys.append(np.asarray(ds.labels))
+                # r5: masked DataSets ride the rounds — as_loss_fn takes
+                # (mask, label_mask) and normalizes each local step by its
+                # shard's valid count. _unpack gives fit_batch's canonical
+                # routing (a labels-only mask plays both roles); the
+                # rebatcher enforces an all-masked-or-none stream, so
+                # presence is uniform across rounds
+                x_, y_, m_, lm_ = _unpack(ds)
+                xs.append(np.asarray(x_))
+                ys.append(np.asarray(y_))
+                if m_ is not None:
+                    ms.append(np.asarray(m_))
+                if lm_ is not None:
+                    lms.append(np.asarray(lm_))
                 have += 1
                 if have == K:
                     carry, loss = trainer.fit_round(
-                        carry, np.concatenate(xs), np.concatenate(ys))
+                        carry, np.concatenate(xs), np.concatenate(ys),
+                        mask=np.concatenate(ms) if ms else None,
+                        label_mask=np.concatenate(lms) if lms else None)
                     self.network.score_value = float(loss)
-                    xs, ys, have = [], [], 0
+                    xs, ys, ms, lms, have = [], [], [], [], 0
             if hasattr(data, "reset"):
                 data.reset()
         if have or dropped_tail:
@@ -265,6 +272,14 @@ class _RebatchingIterator:
 
         for ds in self._source:
             x, y, mask, lmask = _unpack(ds)
+            if isinstance(lmask, (list, tuple, dict)):
+                # the r5 per-output MultiDataSet shape: np.asarray would
+                # stack it [n_out, B, T] and the batch-axis slicing below
+                # would silently corrupt it
+                raise ValueError(
+                    "per-output labels masks (list/dict) are not supported "
+                    "on the spark re-batching path; use a single labels "
+                    "mask array or fit the ComputationGraph directly")
             feats.append(np.asarray(x))
             labels.append(np.asarray(y))
             if mask is not None:
