@@ -14,7 +14,10 @@ from deeplearning4j_tpu.parallel.param_averaging import ParameterAveragingTraine
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.tensor_parallel import TensorParallel
-from deeplearning4j_tpu.parallel.pipeline import GPipe, pipeline_train_step, stack_stage_params
+from deeplearning4j_tpu.parallel.pipeline import (
+    GPipe, HeteroPipe, graph_stage_fn, pack_stage_params,
+    pipeline_train_step, stack_stage_params, unpack_stage_params,
+)
 from deeplearning4j_tpu.parallel.expert import (
     init_moe_params, moe_param_specs, place_moe_params, switch_moe,
 )
@@ -34,7 +37,8 @@ from deeplearning4j_tpu.parallel.compression import (
 )
 
 __all__ = ["DeviceMesh", "multi_slice_mesh", "ParameterAveragingTrainer", "ParallelWrapper", "ParallelInference", "TensorParallel",
-           "GPipe", "pipeline_train_step", "stack_stage_params",
+           "GPipe", "HeteroPipe", "graph_stage_fn", "pack_stage_params",
+           "pipeline_train_step", "stack_stage_params", "unpack_stage_params",
            "init_moe_params", "moe_param_specs", "place_moe_params",
            "switch_moe", "FaultTolerantTrainer", "initialize_distributed",
            "SparkDl4jMultiLayer", "SparkComputationGraph",

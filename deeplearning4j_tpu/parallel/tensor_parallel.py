@@ -67,6 +67,17 @@ _MEGATRON_ROLES = {
     "LearnedSelfAttentionLayer": {
         "Wq": "col", "Wk": "col", "Wv": "col", "Wo": "row", "Q": "rep",
     },
+    # r5 (VERDICT r4 #4): the conv flagship. Conv kernels are
+    # [kh, kw, cin, cout] — output-channel column split (the megatron
+    # column rule lifted to conv); the bias splits with the columns. BN
+    # scale/shift replicate (its stats are per-channel, GSPMD broadcasts
+    # the replicated vector against the channel-sharded activation).
+    # These are layout HINTS: parity vs the unsharded model is asserted
+    # on a conv+BN net in tests and on tiny ResNet-50 in the dryrun.
+    "ConvolutionLayer": {"W": "col", "b": "col"},
+    "SeparableConvolution2DLayer": {"dW": "rep", "pW": "col", "b": "col"},
+    "Deconvolution2DLayer": {"W": "col", "b": "col"},
+    "BatchNormalizationLayer": {"gamma": "rep", "beta": "rep"},
 }
 
 
@@ -129,10 +140,30 @@ class TensorParallel:
         self._placed = False
 
     # ------------------------------------------------------------- placement
+    def _named_params(self):
+        """(layer, param_tree) pairs mirroring model.params — the MLN
+        layer list, or the CG vertex dict (r5: the conv flagship is a
+        ComputationGraph). Returns (pairs, rebuild) where rebuild maps the
+        spec'd trees back into model.params' container shape."""
+        m = self.model
+        if hasattr(m, "layers"):                    # MultiLayerNetwork
+            return list(zip(m.layers, m.params)), list
+        from deeplearning4j_tpu.nn.conf.graph import LayerVertex
+
+        names = [n for n in m.params]               # ComputationGraph
+        pairs = []
+        for n in names:
+            v = m.conf.vertices[n]
+            layer = v.layer if isinstance(v, LayerVertex) else v
+            pairs.append((layer, m.params[n]))
+        return pairs, lambda specs: dict(zip(names, specs))
+
     def param_specs(self):
-        """Per-layer pytrees of PartitionSpec, mirroring model.params."""
+        """Pytrees of PartitionSpec, mirroring model.params (list for MLN,
+        name-keyed dict for ComputationGraph)."""
+        pairs, rebuild = self._named_params()
         specs = []
-        for layer, p in zip(self.model.layers, self.model.params):
+        for layer, p in pairs:
             def spec_for(path, leaf, _layer=layer):
                 name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
                 s = self.rules(_layer, name, np.ndim(leaf))
@@ -141,7 +172,7 @@ class TensorParallel:
                 return s
 
             specs.append(jax.tree_util.tree_map_with_path(spec_for, p))
-        return specs
+        return rebuild(specs)
 
     def place(self):
         specs = self.param_specs()

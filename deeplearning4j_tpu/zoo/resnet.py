@@ -98,3 +98,46 @@ class ResNet50(ZooModel):
         g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), c, shortcut)
         g.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
         return f"{name}_out"
+
+
+def resnet50_pipeline_plan(model, input_shape):
+    """Cut an inited ResNet-50 ComputationGraph at its four conv stage
+    boundaries for :class:`~deeplearning4j_tpu.parallel.HeteroPipe`
+    (r5, VERDICT r4 #4 — PP over the conv flagship).
+
+    Returns (stage_name_lists, head_names, shapes):
+    - stage_name_lists: four contiguous topological vertex slices (the stem
+      folds into the first); each slice's only external input is the
+      previous slice's output — the conv2/3/4/5 boundaries.
+    - head_names: the replicated tail (global pool + classifier head).
+    - shapes: per-example activation shapes [input, s1_in, s2_in, s3_in,
+      pipeline_out] — what HeteroPipe needs for its padded ring buffer.
+
+    ``input_shape``: per-example input, e.g. (32, 32, 3).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    conf = model.conf
+    order = [n for n in conf.topological_order
+             if n not in conf.network_inputs]
+    cuts = []
+    for si in range(4):
+        idx = max(i for i, n in enumerate(order)
+                  if n.startswith(f"s{si}b"))
+        cuts.append(idx)
+    stages, start = [], 0
+    for idx in cuts:
+        stages.append(order[start:idx + 1])
+        start = idx + 1
+    head = order[start:]
+
+    # activation shapes at the stage entries, via eval_shape (no FLOPs)
+    acts = jax.eval_shape(
+        lambda p, s, x: model._forward(p, s, {"input": x}, False, None)[0],
+        model.params, model.state,
+        jax.ShapeDtypeStruct((1,) + tuple(input_shape), jnp.float32))
+    shapes = [tuple(input_shape)]
+    for st in stages:
+        shapes.append(tuple(acts[st[-1]].shape[1:]))
+    return stages, head, shapes

@@ -1325,3 +1325,128 @@ class TestMaskedLocalSGD:
 
         la, lb = round_loss(y), round_loss(y_g)
         assert la == pytest.approx(lb, rel=1e-5), (la, lb)
+
+
+class TestConvShardingAndHeteroPipe:
+    """r5 (VERDICT r4 #4): the conv flagship sharded — structure-based TP
+    roles for Conv/BN on the ComputationGraph tier, and the heterogeneous
+    GPipe (HeteroPipe) that carries ResNet-50-style stages whose
+    activation shapes and param structures differ."""
+
+    def _conv_graph(self, seed=11):
+        from deeplearning4j_tpu.nn import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import (ActivationLayer,
+                                                  BatchNormalizationLayer,
+                                                  ConvolutionLayer,
+                                                  GlobalPoolingLayer)
+
+        g = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.05))
+             .graph_builder().add_inputs("in")
+             .set_input_types(**{"in": InputType.convolutional(8, 8, 3)})
+             .add_layer("c1", ConvolutionLayer(n_out=16, kernel=(3, 3),
+                                               padding="same",
+                                               has_bias=False), "in")
+             .add_layer("bn1", BatchNormalizationLayer(), "c1")
+             .add_layer("r1", ActivationLayer(activation="relu"), "bn1")
+             .add_layer("c2", ConvolutionLayer(n_out=32, kernel=(3, 3),
+                                               padding="same"), "r1")
+             .add_layer("gp", GlobalPoolingLayer(pooling_type="avg"), "c2")
+             .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                           loss="mcxent"), "gp")
+             .set_outputs("out").build())
+        return ComputationGraph(g).init()
+
+    def test_tp_conv_graph_matches_single_device(self, rng):
+        """Conv kernels column-split over "model", BN replicated: the TP
+        train step must reproduce the single-device step exactly (GSPMD
+        layout hints never change the math)."""
+        from deeplearning4j_tpu.parallel import TensorParallel
+
+        x = rng.normal(size=(8, 8, 8, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        tp = TensorParallel(self._conv_graph(),
+                            DeviceMesh(data=2, model=4))
+        ref = self._conv_graph()
+        l_tp = [tp.fit_batch((x, y)) for _ in range(3)]
+        l_ref = [ref.fit_batch((x, y)) for _ in range(3)]
+        np.testing.assert_allclose(l_tp, l_ref, rtol=2e-5)
+        for name in ref.params:
+            for k in ref.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(tp.model.params[name][k]),
+                    np.asarray(ref.params[name][k]), rtol=1e-4, atol=1e-6)
+
+    def test_tp_conv_specs_shard_conv_kernels(self):
+        """The structure-based role table actually fires for conv layers:
+        kernels get a "model"-sharded last axis, BN params replicate."""
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel import TensorParallel
+
+        tp = TensorParallel(self._conv_graph(), DeviceMesh(data=2, model=4))
+        specs = tp.param_specs()
+        assert specs["c1"]["W"] == P(None, None, None, "model")
+        assert specs["c2"]["b"] == P("model")
+        assert specs["bn1"]["gamma"] == P()
+
+    def test_heteropipe_matches_sequential(self):
+        """4 heterogeneous stages (shapes shrink 16->12->8->4, different
+        param structures): pipelined output and grads == unpipelined."""
+        from deeplearning4j_tpu.parallel import (HeteroPipe,
+                                                 pack_stage_params)
+
+        key = jax.random.key(0)
+        dims = [16, 12, 8, 4, 4]
+        stage_params, stage_fns = [], []
+        for s in range(4):
+            W = jax.random.normal(jax.random.fold_in(key, s),
+                                  (dims[s], dims[s + 1])) * 0.4
+            if s % 2 == 0:     # alternate param STRUCTURES
+                stage_params.append({"W": W, "b": jnp.zeros(dims[s + 1])})
+                stage_fns.append(
+                    lambda p, x: jnp.tanh(x @ p["W"] + p["b"]))
+            else:
+                stage_params.append({"W": W})
+                stage_fns.append(lambda p, x: jnp.tanh(x @ p["W"]))
+        packed, metas = pack_stage_params(stage_params)
+        mesh = DeviceMesh(data=1, pipe=4, devices=jax.devices()[:4])
+        pipe = HeteroPipe(stage_fns, metas,
+                          [(d,) for d in dims], mesh, n_microbatches=2)
+        x = jax.random.normal(jax.random.fold_in(key, 9), (6, 16))
+        with mesh.mesh:
+            y = pipe(packed, x)
+        y_ref = pipe.sequential_reference(packed, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-6)
+        # pipelined backward == unpipelined backward
+        with mesh.mesh:
+            g = jax.jit(jax.grad(lambda p: (pipe(p, x) ** 2).sum()))(packed)
+        g_ref = jax.grad(
+            lambda p: (pipe.sequential_reference(p, x) ** 2).sum())(packed)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_graph_stage_fn_rejects_noncontiguous_cut(self):
+        from deeplearning4j_tpu.parallel import graph_stage_fn
+
+        m = self._conv_graph()
+        # "r1" depends on bn1 which is neither in the slice nor the entry
+        with pytest.raises(ValueError, match="outside the stage"):
+            graph_stage_fn(m, ["r1", "c2"], "c1")
+
+    def test_resnet50_pipeline_plan_shapes(self):
+        """The four conv stage cuts are contiguous and the eval_shape
+        probe reports the shrinking stage-entry activations."""
+        from deeplearning4j_tpu.parallel import graph_stage_fn
+        from deeplearning4j_tpu.zoo import ResNet50
+        from deeplearning4j_tpu.zoo.resnet import resnet50_pipeline_plan
+
+        m = ResNet50(height=16, width=16, num_classes=4,
+                     dtype="float32").init()
+        stages, head, shapes = resnet50_pipeline_plan(m, (16, 16, 3))
+        assert len(stages) == 4 and head[-1] == "output"
+        assert shapes[0] == (16, 16, 3) and shapes[-1][-1] == 2048
+        # every cut is a closed contiguous slice (graph_stage_fn validates)
+        entries = ["input"] + [s[-1] for s in stages[:-1]]
+        for s, e in zip(stages, entries):
+            graph_stage_fn(m, s, e)
