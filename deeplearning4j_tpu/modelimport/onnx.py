@@ -540,10 +540,25 @@ def _size(node, xs):
     return np.asarray(np.size(xs[0]), np.int64)
 
 
+# as_trainable(compute_dtype=...) sets this for the duration of ITS trace
+# only — a ContextVar, so concurrent traces of other imported graphs (other
+# threads / unrelated f32 models) are never redirected.
+_CAST_FLOAT_OVERRIDE = __import__("contextvars").ContextVar(
+    "onnx_cast_float_override", default=None)
+
+
 @onnx_op("Cast")
 def _cast(node, xs):
     to = node.attr("to")
     dt = _ONNX_ATTR_DTYPES.get(to.i if to is not None else 1, np.float32)
+    # mixed-precision fine-tune (r5): exporter-emitted Cast-to-FLOAT/DOUBLE
+    # nodes (torch's attention-mask path) would promote the whole bf16
+    # graph back to f32; under a compute-dtype override they cast to the
+    # compute dtype instead. Integer/bool/fp16 casts are untouched.
+    override = _CAST_FLOAT_OVERRIDE.get()
+    if override is not None and np.dtype(dt) in (np.dtype(np.float32),
+                                                 np.dtype(np.float64)):
+        dt = override
     # works for numpy constants and jax arrays alike; numpy stays concrete
     return xs[0].astype(dt)
 
@@ -968,7 +983,8 @@ class OnnxImportedGraph:
         return out
 
     def as_trainable(self, outputs: Optional[List[str]] = None,
-                     trainable: Optional[List[str]] = None):
+                     trainable: Optional[List[str]] = None,
+                     compute_dtype=None):
         """(fn, params) for FINE-TUNING the imported model.
 
         The reference's headline TF-import flow is import-then-train
@@ -977,6 +993,15 @@ class OnnxImportedGraph:
         ``fn(params, feeds) -> outputs`` is jit/grad-able with respect to
         ``params``. ``trainable`` restricts which initializers move (the
         rest stay frozen constants); default: every float initializer.
+
+        ``compute_dtype`` (r5): mixed-precision fine-tuning of the
+        imported graph. Float FROZEN constants (folded subgraphs, scalar
+        eps/scale consts) are cast to this dtype inside ``fn``, so that
+        bf16 caller-cast params are not silently promoted back to f32 by
+        an f32 scalar riding every LayerNorm/softmax — the analog of the
+        zoo models' compute-dtype policy. Integer/bool constants (shape
+        arithmetic, indices) keep their dtypes. None (default) keeps the
+        exported dtypes everywhere.
         """
         import jax.numpy as jnp
 
@@ -990,13 +1015,28 @@ class OnnxImportedGraph:
         params = {k: jnp.asarray(self.initializers[k]) for k in names}
         baked = self.fold_constants(exclude=set(names))
 
+        def _cast_const(v):
+            if compute_dtype is None:
+                return v
+            a = np.asarray(v)
+            if np.issubdtype(a.dtype, np.floating):
+                return jnp.asarray(a, dtype=compute_dtype)
+            return v
+
         def fn(params, feeds):
-            acts: Dict[str, object] = dict(self.initializers)
-            acts.update(baked)
+            acts: Dict[str, object] = {k: _cast_const(v)
+                                       for k, v in self.initializers.items()}
+            acts.update({k: _cast_const(v) for k, v in baked.items()})
             acts.update(params)
             for k, v in feeds.items():
                 acts[k] = jnp.asarray(v)
-            return self._run(acts, outputs)
+            if compute_dtype is None:
+                return self._run(acts, outputs)
+            token = _CAST_FLOAT_OVERRIDE.set(compute_dtype)
+            try:
+                return self._run(acts, outputs)
+            finally:
+                _CAST_FLOAT_OVERRIDE.reset(token)
 
         return fn, params
 
