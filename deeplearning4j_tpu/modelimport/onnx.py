@@ -551,10 +551,15 @@ _CAST_FLOAT_OVERRIDE = __import__("contextvars").ContextVar(
 def _cast(node, xs):
     to = node.attr("to")
     dt = _ONNX_ATTR_DTYPES.get(to.i if to is not None else 1, np.float32)
-    # mixed-precision fine-tune (r5): exporter-emitted Cast-to-FLOAT/DOUBLE
-    # nodes (torch's attention-mask path) would promote the whole bf16
-    # graph back to f32; under a compute-dtype override they cast to the
-    # compute dtype instead. Integer/bool/fp16 casts are untouched.
+    # mixed-precision fine-tune (r5): under a compute-dtype override,
+    # every Cast-to-FLOAT/DOUBLE produces the compute dtype — including
+    # integer-sourced casts (torch's int64 attention-mask path), which
+    # would otherwise promote the whole bf16 graph back to f32 at the
+    # first mask add. This is the torch-autocast contract: ALL float
+    # quantities (mask values, length-derived scalars) live in the
+    # compute dtype, and integer values outside its exact range (>256
+    # for bf16) round — the documented cost of opting in. fp16
+    # destinations (already reduced) are untouched.
     override = _CAST_FLOAT_OVERRIDE.get()
     if override is not None and np.dtype(dt) in (np.dtype(np.float32),
                                                  np.dtype(np.float64)):
@@ -995,13 +1000,18 @@ class OnnxImportedGraph:
         rest stay frozen constants); default: every float initializer.
 
         ``compute_dtype`` (r5): mixed-precision fine-tuning of the
-        imported graph. Float FROZEN constants (folded subgraphs, scalar
-        eps/scale consts) are cast to this dtype inside ``fn``, so that
-        bf16 caller-cast params are not silently promoted back to f32 by
-        an f32 scalar riding every LayerNorm/softmax — the analog of the
-        zoo models' compute-dtype policy. Integer/bool constants (shape
-        arithmetic, indices) keep their dtypes. None (default) keeps the
-        exported dtypes everywhere.
+        imported graph, with torch-autocast semantics. Float FROZEN
+        constants (folded subgraphs, scalar eps/scale consts) are cast
+        to this dtype, and every in-graph Cast-to-FLOAT/DOUBLE produces
+        it — including integer-sourced casts (attention masks, position
+        ids) — so bf16 caller-cast params are never silently promoted
+        back to f32 mid-graph. The documented cost: integer-derived
+        float values outside the compute dtype's exact range (> 256 for
+        bf16 — e.g. a sequence-length sum feeding a mean-pool) round to
+        the nearest representable; pass trainable= / keep
+        compute_dtype=None for graphs where that matters. Integer/bool
+        constants (shape arithmetic, indices) always keep their dtypes.
+        None (default) keeps the exported dtypes everywhere.
         """
         import jax.numpy as jnp
 
@@ -1023,10 +1033,15 @@ class OnnxImportedGraph:
                 return jnp.asarray(a, dtype=compute_dtype)
             return v
 
+        # cast the frozen constants ONCE — fn is plain-callable (not
+        # jit-required) and must not re-transfer the whole non-trainable
+        # weight set on every eager call
+        consts: Dict[str, object] = {k: _cast_const(v)
+                                     for k, v in self.initializers.items()}
+        consts.update({k: _cast_const(v) for k, v in baked.items()})
+
         def fn(params, feeds):
-            acts: Dict[str, object] = {k: _cast_const(v)
-                                       for k, v in self.initializers.items()}
-            acts.update({k: _cast_const(v) for k, v in baked.items()})
+            acts = dict(consts)
             acts.update(params)
             for k, v in feeds.items():
                 acts[k] = jnp.asarray(v)

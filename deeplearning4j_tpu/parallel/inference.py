@@ -27,11 +27,18 @@ class ParallelInference:
     """
 
     def __init__(self, model, mesh: Optional[DeviceMesh] = None,
-                 batch_limit: int = 32, queue_timeout_s: float = 0.005):
+                 batch_limit: int = 32, queue_timeout_s: float = 0.005,
+                 pad_batches: bool = True):
         self.model = model
         self.mesh = mesh
         self.batch_limit = batch_limit
         self.queue_timeout_s = queue_timeout_s
+        # r5 (serving perf): a partially-filled batch is zero-padded up to
+        # the next power of two before dispatch, so the jitted forward
+        # compiles at most log2(batch_limit)+1 programs instead of one per
+        # observed batch size (a retrace storm under bursty load — every
+        # new size stalled its whole batch behind an XLA compile)
+        self.pad_batches = pad_batches
         self._q: queue.Queue = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -74,6 +81,12 @@ class ParallelInference:
                 except queue.Empty:
                     break
             xs = np.stack([b[0] for b in batch])
-            ys = np.asarray(self.output(xs))
+            n = xs.shape[0]
+            if self.pad_batches and n > 1:
+                bucket = min(1 << (n - 1).bit_length(), self.batch_limit)
+                if bucket > n:
+                    pad = np.zeros((bucket - n,) + xs.shape[1:], xs.dtype)
+                    xs = np.concatenate([xs, pad])
+            ys = np.asarray(self.output(xs))[:n]
             for (x, out), y in zip(batch, ys):
                 out.put(y)

@@ -1450,3 +1450,50 @@ class TestConvShardingAndHeteroPipe:
         entries = ["input"] + [s[-1] for s in stages[:-1]]
         for s, e in zip(stages, entries):
             graph_stage_fn(m, s, e)
+
+
+class TestInferencePadBatches:
+    def test_padded_partial_batches_return_correct_results(self, rng):
+        """r5 serving fix: partially-filled batches are zero-padded to the
+        next pow2 bucket before dispatch (bounded compile set); results
+        must match the direct forward exactly for the REAL rows."""
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        model = _model(seed=2)
+        xs = rng.normal(size=(5, 8)).astype(np.float32)   # -> bucket 8
+        pi = ParallelInference(model, batch_limit=8,
+                               queue_timeout_s=0.05).start()
+        try:
+            queues = [pi.submit(x) for x in xs]
+            got = np.stack([q.get(timeout=30) for q in queues])
+        finally:
+            pi.stop()
+        want = np.asarray(model.output(xs))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_pad_batches_bounds_the_compile_set(self, rng):
+        """Every dispatched batch size is a power of two (or 1): the
+        padded worker can only ever trace log2(limit)+1 programs."""
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        model = _model(seed=2)
+        seen = []
+        orig = model.output
+
+        def spy(x, **kw):
+            seen.append(np.shape(x)[0])
+            return orig(x, **kw)
+
+        model.output = spy
+        pi = ParallelInference(model, batch_limit=16,
+                               queue_timeout_s=0.02).start()
+        try:
+            for n in (3, 5, 7, 11, 13):
+                qs = [pi.submit(rng.normal(size=8).astype(np.float32))
+                      for _ in range(n)]
+                for q in qs:
+                    q.get(timeout=30)
+        finally:
+            pi.stop()
+            model.output = orig
+        assert seen and all(s == 1 or (s & (s - 1)) == 0 for s in seen), seen
