@@ -205,16 +205,38 @@ class Word2Vec:
                 yield toks
 
     def _pairs(self, encoded: List[np.ndarray], rng) -> np.ndarray:
-        """All (center, context) skip-gram pairs with random window shrink."""
-        pairs = []
-        for sent in encoded:
-            n = len(sent)
-            for i in range(n):
-                b = rng.integers(1, self.window + 1)
-                for j in range(max(0, i - b), min(n, i + b + 1)):
-                    if j != i:
-                        pairs.append((sent[i], sent[j]))
-        return np.asarray(pairs, np.int32).reshape(-1, 2)
+        """All (center, context) skip-gram pairs with random window shrink.
+
+        Vectorized over the whole chunk (r5): sentences concatenate into
+        one flat token array with per-token sentence positions, and each
+        offset d in 1..window contributes its valid left/right pairs in
+        two boolean-mask passes — no per-token Python loop. The measured
+        host windowing rate went from ~50k words/sec (the r4 double loop,
+        a 40x bottleneck under the 2M words/sec device step) to the
+        numpy-bound rate; pair semantics are identical (one uniform
+        window shrink b per center, both directions share it)."""
+        lens = np.asarray([len(s) for s in encoded], np.int64)
+        total = int(lens.sum())
+        if total == 0:
+            return np.zeros((0, 2), np.int32)
+        flat = np.concatenate([np.asarray(s, np.int32) for s in encoded])
+        starts = np.repeat(np.cumsum(lens) - lens, lens)
+        pos = np.arange(total) - starts          # position within sentence
+        slen = np.repeat(lens, lens)
+        b = rng.integers(1, self.window + 1, total)
+        cs, xs = [], []
+        for d in range(1, self.window + 1):
+            reach = b >= d
+            right = reach & (pos + d < slen)
+            left = reach & (pos >= d)
+            ri = np.nonzero(right)[0]
+            li = np.nonzero(left)[0]
+            cs.append(flat[ri])
+            xs.append(flat[ri + d])
+            cs.append(flat[li])
+            xs.append(flat[li - d])
+        return np.stack([np.concatenate(cs), np.concatenate(xs)],
+                        axis=1).astype(np.int32)
 
     def fit(self, corpus, chunk_sentences: int = 4096) -> "Word2Vec":
         """Two streaming passes per epoch over ``corpus`` (r4): pass 1
@@ -281,14 +303,19 @@ class Word2Vec:
                 if len(pairs) == 0:
                     return
                 pairs = pairs[rng.permutation(len(pairs))]
-                # batches reuse one compiled step shape
+                # batches reuse one compiled step shape; negatives for the
+                # WHOLE chunk come from one sampler call (r5 — per-batch
+                # searchsorted calls were a measured host hot spot)
                 B = min(self.batch_size, len(pairs))
-                for s in range(0, (len(pairs) // B) * B, B):
+                nb = len(pairs) // B
+                negs_all = sampler.sample(rng, (nb, B, self.negative))
+                for k in range(nb):
+                    s = k * B
                     batch = pairs[s:s + B]
-                    negs = sampler.sample(rng, (B, self.negative))
                     W, C, _ = _sg_neg_step(W, C, jnp.asarray(batch[:, 0]),
                                            jnp.asarray(batch[:, 1]),
-                                           jnp.asarray(negs), lr=self.lr)
+                                           jnp.asarray(negs_all[k]),
+                                           lr=self.lr)
 
         for epoch in range(self.epochs):
             if hasattr(corpus, "reset"):
