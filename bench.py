@@ -1111,24 +1111,34 @@ def bench_nlp(n_sentences=50000, sent_len=19, vocab=10000, rounds=3):
     n_words = n_sentences * sent_len
 
     try:
+        import contextlib
+
+        import deeplearning4j_tpu.nlp.word2vec as _w2v_mod
+
+        @contextlib.contextmanager
+        def _noop_device_step():
+            # host_only: the compiled update becomes a no-op — measures
+            # the numpy windowing/shuffle/sampling stream. NOTE: the
+            # per-batch jnp.asarray host->device transfers still run (the
+            # transfer sits inside train_chunk, upstream of the step), so
+            # host_only is "everything except the compute", not "pure
+            # numpy"
+            orig = _w2v_mod._sg_neg_step
+            _w2v_mod._sg_neg_step = lambda W, C, a, b, n, lr: (W, C, 0.0)
+            try:
+                yield
+            finally:
+                _w2v_mod._sg_neg_step = orig
+
         def fit_once(train=True):
             w2v = Word2Vec(vector_size=100, window=5, negative=5,
                            min_count=1, epochs=1, batch_size=2048, seed=1)
-            if not train:
-                # host_only: everything but the device step — measures the
-                # numpy windowing/shuffle/negative-sampling stream
-                import deeplearning4j_tpu.nlp.word2vec as _w2v_mod
-                orig = _w2v_mod._sg_neg_step
-                _w2v_mod._sg_neg_step = lambda W, C, a, b, n, lr: (W, C, 0.0)
-                try:
-                    t0 = time.perf_counter()
-                    w2v.fit(LineSentenceIterator(path))
-                    return n_words / (time.perf_counter() - t0)
-                finally:
-                    _w2v_mod._sg_neg_step = orig
-            t0 = time.perf_counter()
-            w2v.fit(LineSentenceIterator(path))
-            return n_words / (time.perf_counter() - t0)
+            ctx = (contextlib.nullcontext() if train
+                   else _noop_device_step())
+            with ctx:
+                t0 = time.perf_counter()
+                w2v.fit(LineSentenceIterator(path))
+                return n_words / (time.perf_counter() - t0)
 
         e2e = sorted(fit_once() for _ in range(rounds))[rounds // 2]
         host = sorted(fit_once(train=False)
@@ -1180,9 +1190,10 @@ def bench_nlp(n_sentences=50000, sent_len=19, vocab=10000, rounds=3):
                       "batch 2048",
             "bottleneck": ("host windowing/sampling"
                            if host < dev_words else "device step"),
-            "note": "the host numpy stream is single-threaded (the "
-                    "reference parallelizes this with Hogwild workers); "
-                    "end_to_end ~= harmonic composition of the two",
+            "note": "the host stream is single-threaded (the reference "
+                    "parallelizes this with Hogwild workers); host_only "
+                    "still pays the per-batch host->device transfers, so "
+                    "it bounds the pure-numpy rate from BELOW",
         }
     finally:
         os.unlink(path)

@@ -65,18 +65,33 @@ class FaultTolerantTrainer:
         from deeplearning4j_tpu.util.checkpoints import TrainingCheckpointer
 
         self.model = model
+        # r5: a parallel facade (ParallelWrapper / TensorParallel) trains,
+        # but its .model owns params/opt_state/step_count — train through
+        # the facade, checkpoint the owner. The unwrap is deliberately
+        # narrow (isinstance, not duck-typed .model) so an unrelated
+        # object with a .model attribute is checkpointed as itself.
+        # Under jax.distributed EVERY process constructs the trainer and
+        # calls save/restore at the same steps; orbax coordinates the
+        # multi-process write and its committed step directories make the
+        # recovery point atomic.
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+        from deeplearning4j_tpu.parallel.tensor_parallel import TensorParallel
+
+        self._target = (model.model
+                        if isinstance(model, (ParallelWrapper, TensorParallel))
+                        else model)
         self.save_every = max(1, save_every)
         self.checkpointer = TrainingCheckpointer(checkpoint_dir,
                                                  keep_last=keep_last)
-        self.restored_step = self.checkpointer.restore_latest(model)
+        self.restored_step = self.checkpointer.restore_latest(self._target)
         if self.restored_step is not None and on_restore:
             on_restore(self.restored_step)
 
     def fit_batch(self, ds) -> float:
         loss = self.model.fit_batch(ds)
-        step = self.model.step_count
+        step = self._target.step_count
         if step % self.save_every == 0:
-            self.checkpointer.save(step, self.model)
+            self.checkpointer.save(step, self._target)
         return loss
 
     def fit(self, data, epochs: int = 1):
@@ -85,8 +100,8 @@ class FaultTolerantTrainer:
                 self.fit_batch(ds)
             if hasattr(data, "reset"):
                 data.reset()
-            self.model.epoch_count += 1
-        self.checkpointer.save(self.model.step_count, self.model)
+            self._target.epoch_count += 1
+        self.checkpointer.save(self._target.step_count, self._target)
         self.checkpointer.wait()
         return self.model
 

@@ -66,9 +66,16 @@ class TrainingCheckpointer:
                     "opt_state": model.opt_state}
         restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(template))
-        model.params = restored["params"]
-        model.state = restored["state"]
-        model.opt_state = restored["opt_state"]
+        # hand back HOST arrays (r5): the consuming trainer re-places them
+        # exactly like a fresh init. Assigning the restored device arrays
+        # directly would make a multi-host relaunch's replication a
+        # cross-host device transfer, which CPU/Gloo backends reject —
+        # and on any backend the next step re-places params anyway.
+        import jax
+
+        model.params = jax.device_get(restored["params"])
+        model.state = jax.device_get(restored["state"])
+        model.opt_state = jax.device_get(restored["opt_state"])
         model.step_count = int(step)
         return int(step)
 
