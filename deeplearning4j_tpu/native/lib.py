@@ -107,6 +107,28 @@ def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_cache_trim.restype = c.c_long
     lib.dl4j_cache_trim.argtypes = [c.c_char_p, c.c_long]
 
+    lib.dl4j_wc_create.restype = c.c_void_p
+    lib.dl4j_wc_create.argtypes = [c.c_char_p, c.c_int]
+    lib.dl4j_wc_bytes.restype = c.c_long
+    lib.dl4j_wc_bytes.argtypes = [c.c_void_p]
+    lib.dl4j_wc_dump.argtypes = [c.c_void_p, c.c_char_p]
+    lib.dl4j_wc_destroy.argtypes = [c.c_void_p]
+
+    lib.dl4j_w2v_create.restype = c.c_void_p
+    lib.dl4j_w2v_create.argtypes = [c.c_char_p, c.c_char_p, c.c_long,
+                                    c.POINTER(c.c_float),
+                                    c.POINTER(c.c_float), c.c_int, c.c_int,
+                                    c.c_long, c.c_uint, c.c_int, c.c_int]
+    lib.dl4j_w2v_next.restype = c.c_int
+    lib.dl4j_w2v_next.argtypes = [c.c_void_p, c.POINTER(c.c_int32),
+                                  c.POINTER(c.c_int32), c.POINTER(c.c_int32)]
+    lib.dl4j_w2v_reset.argtypes = [c.c_void_p]
+    lib.dl4j_w2v_words.restype = c.c_long
+    lib.dl4j_w2v_words.argtypes = [c.c_void_p]
+    lib.dl4j_w2v_pairs.restype = c.c_long
+    lib.dl4j_w2v_pairs.argtypes = [c.c_void_p]
+    lib.dl4j_w2v_destroy.argtypes = [c.c_void_p]
+
     if hasattr(lib, "dl4j_image_decode"):     # codec build present
         lib.dl4j_image_probe.restype = c.c_int
         lib.dl4j_image_probe.argtypes = [c.c_char_p, c.POINTER(c.c_long),

@@ -32,6 +32,20 @@ class VocabCache:
         self._total = sum(c for _, c in kept)
         return self
 
+    def fit_from_counts(self, counts) -> "VocabCache":
+        """Build from a precomputed word->count mapping (the native
+        concurrent counting pass, nlp.native_text.native_word_counts).
+        Ties order by word so the index assignment is deterministic even
+        though concurrent counting loses first-seen order."""
+        self.counts = Counter(counts)
+        kept = sorted(((w, c) for w, c in self.counts.items()
+                       if c >= self.min_count),
+                      key=lambda wc: (-wc[1], wc[0]))
+        self.words = [w for w, _ in kept]
+        self.index = {w: i for i, w in enumerate(self.words)}
+        self._total = sum(c for _, c in kept)
+        return self
+
     def __len__(self):
         return len(self.words)
 
@@ -62,6 +76,28 @@ class VocabCache:
         f = f / max(self._total, 1)
         keep = np.minimum(1.0, np.sqrt(t / np.maximum(f, 1e-12)) + t / np.maximum(f, 1e-12))
         return keep.astype(np.float32)
+
+
+def build_alias_table(probs: np.ndarray):
+    """Vose alias table (prob [V] f32, alias [V] i32) for O(1) categorical
+    sampling: draw k uniform, return k if u < prob[k] else alias[k].
+    Device-resident twin of the native AliasTable — the scanned Word2Vec
+    step samples negatives ON the TPU so the host ships only (center,
+    context) pairs."""
+    p = np.asarray(probs, np.float64)
+    n = len(p)
+    scaled = p / p.sum() * n
+    alias = np.zeros(n, np.int32)
+    prob = np.ones(n, np.float64)
+    small = [i for i in range(n) if scaled[i] < 1.0]
+    large = [i for i in range(n) if scaled[i] >= 1.0]
+    while small and large:
+        s, l = small.pop(), large.pop()
+        prob[s] = scaled[s]
+        alias[s] = l
+        scaled[l] += scaled[s] - 1.0
+        (small if scaled[l] < 1.0 else large).append(l)
+    return prob.astype(np.float32), alias
 
 
 class NegativeSampler:

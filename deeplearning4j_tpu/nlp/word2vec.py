@@ -12,6 +12,7 @@ the gradient of gather, which XLA fuses; the MXU sees one [batch, dim] x
 from __future__ import annotations
 
 import functools
+import os
 from typing import Iterable, List, Optional
 
 import jax
@@ -19,7 +20,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.tokenizers import CommonPreprocessor, DefaultTokenizerFactory
-from deeplearning4j_tpu.nlp.vocab import NegativeSampler, VocabCache, cosine_similarity
+from deeplearning4j_tpu.nlp.vocab import (NegativeSampler, VocabCache,
+                                          build_alias_table,
+                                          cosine_similarity)
 
 
 def cbow_windows(encoded, window: int):
@@ -59,6 +62,46 @@ def _sg_neg_step(W, C, center, context, negatives, lr):
     W = W - lr * grads[0]
     C = C - lr * grads[1]
     return W, C, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("lr", "k"))
+def _sg_neg_steps_devneg(W, C, key, centers, contexts, aprob, aalias, lr, k):
+    """S sequential negative-sampling steps in ONE dispatch: centers [S, B]
+    and contexts [S, B] scanned over axis 0, so one host->device transfer
+    and one XLA launch cover S batches — per-batch dispatch latency
+    (significant under a tunneled PJRT client) amortizes S-fold while the
+    update math stays bit-identical to S calls of _sg_neg_step.
+
+    Negatives are sampled ON DEVICE from a Vose alias table (aprob [V]
+    f32, aalias [V] i32) — the host ships only (center, context) pairs
+    (uint16 when the vocab fits), cutting host->device bytes 14x vs
+    staging int32 (center, context, negs[S, B, K]). Distribution is the
+    same unigram^0.75 (alias method); draws come from the JAX PRNG
+    instead of the host stream."""
+    V = W.shape[0]
+
+    def body(carry, batch):
+        W_, C_, key_ = carry
+        center, context = (b.astype(jnp.int32) for b in batch)
+        key_, k1, k2 = jax.random.split(key_, 3)
+        idx = jax.random.randint(k1, (center.shape[0], k), 0, V)
+        u = jax.random.uniform(k2, (center.shape[0], k))
+        negs = jnp.where(u < aprob[idx], idx, aalias[idx])
+
+        def loss_fn(params):
+            Wp, Cp = params
+            w = Wp[center]
+            pos = jnp.einsum("bd,bd->b", w, Cp[context])
+            neg = jnp.einsum("bd,bkd->bk", w, Cp[negs])
+            return (-jax.nn.log_sigmoid(pos).sum()
+                    - jax.nn.log_sigmoid(-neg).sum())
+
+        loss, g = jax.value_and_grad(loss_fn)((W_, C_))
+        return (W_ - lr * g[0], C_ - lr * g[1], key_), loss
+
+    (W, C, _), losses = jax.lax.scan(body, (W, C, key), (centers, contexts))
+    return W, C, losses.sum()
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
@@ -161,6 +204,37 @@ def _sg_hs_step(W, Theta, accW, accT, center, context, codes, points, mask, lr):
     return W, Theta, accW, accT, loss
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3),
+                   static_argnames=("lr",))
+def _sg_hs_steps(W, Theta, accW, accT, centers, contexts, codes, points,
+                 mask, lr):
+    """S sequential hierarchical-softmax steps in one dispatch (the scan
+    twin of _sg_hs_step; see _sg_neg_steps_devneg for why): centers/contexts
+    [S, B] scanned; the Huffman tables ride along unscanned."""
+
+    def body(carry, batch):
+        W_, T_, aW, aT = carry
+        center, context = batch
+
+        def loss_fn(params):
+            Wp, Tp = params
+            w = Wp[center]
+            th = Tp[points[context]]
+            sign = 1.0 - 2.0 * codes[context].astype(jnp.float32)
+            logits = sign * jnp.einsum("bd,bld->bl", w, th)
+            return -(jax.nn.log_sigmoid(logits) * mask[context]).sum()
+
+        loss, g = jax.value_and_grad(loss_fn)((W_, T_))
+        aW = aW + g[0] * g[0]
+        aT = aT + g[1] * g[1]
+        return (W_ - lr * g[0] / jnp.sqrt(aW + 1e-8),
+                T_ - lr * g[1] / jnp.sqrt(aT + 1e-8), aW, aT), loss
+
+    (W, Theta, accW, accT), losses = jax.lax.scan(
+        body, (W, Theta, accW, accT), (centers, contexts))
+    return W, Theta, accW, accT, losses.sum()
+
+
 class Word2Vec:
     """Builder-style Word2Vec (reference: Word2Vec.Builder()...build().fit()).
 
@@ -172,11 +246,15 @@ class Word2Vec:
                  min_count: int = 1, negative: int = 5, epochs: int = 1,
                  learning_rate: float = 0.025, cbow: bool = False,
                  subsample: float = 0.0, batch_size: int = 512, seed: int = 42,
-                 hs: bool = False):
+                 hs: bool = False, workers: int = 0):
         self.vector_size = vector_size
         self.window = window
         self.negative = negative
         self.hs = hs
+        # host-side worker threads for the native concurrent front
+        # (reference: Word2Vec.Builder().workers(n) — its Hogwild thread
+        # count); 0 = auto
+        self.workers = workers if workers > 0 else min(8, os.cpu_count() or 4)
         self.epochs = epochs
         self.lr = learning_rate
         self.cbow = cbow
@@ -238,15 +316,168 @@ class Word2Vec:
         return np.stack([np.concatenate(cs), np.concatenate(xs)],
                         axis=1).astype(np.int32)
 
-    def fit(self, corpus, chunk_sentences: int = 4096) -> "Word2Vec":
+    # ------------------------------------------------- native concurrent front
+    def _native_corpus_path(self, corpus) -> Optional[str]:
+        """File path when ``corpus`` qualifies for the native concurrent
+        front (see _fit_native), else None."""
+        from deeplearning4j_tpu.native.lib import native_available
+        from deeplearning4j_tpu.nlp.corpus import LineSentenceIterator
+
+        if (type(corpus) is LineSentenceIterator
+                and corpus.preprocessor is None
+                and corpus.encoding.lower().replace("-", "") == "utf8"
+                and not self.cbow
+                and type(self.tokenizer) is DefaultTokenizerFactory
+                and type(self.tokenizer.preprocessor) is CommonPreprocessor
+                and os.path.isfile(corpus.path)
+                and native_available()):
+            return corpus.path
+        return None
+
+    @staticmethod
+    def _ascii_sample(path: str, limit: int = 1 << 20) -> bool:
+        """True when the first ``limit`` bytes are pure ASCII. The native
+        tokenizer only matches the Python one (lowercase + [^\\w\\s] strip)
+        for ASCII text — non-ASCII bytes pass through unlowercased and
+        unicode punctuation survives — so AUTO selection requires an ASCII
+        sample; ``native_front=True`` overrides (byte-level semantics,
+        documented in nlp.native_text)."""
+        with open(path, "rb") as f:
+            head = f.read(limit)
+        return not head or max(head) < 0x80
+
+    def _fit_native(self, path: str, rng) -> Optional["Word2Vec"]:
+        """Train over the native concurrent text front: N C++ threads
+        tokenize/encode/subsample/window/negative-sample line-chunks in
+        parallel (native/dl4jtpu_native.cpp) while this thread runs the
+        jitted device step — the reference's Hogwild host concurrency with
+        a single-program device side. Like the reference's threaded
+        trainer, batch arrival order is nondeterministic run-to-run; pass
+        ``native_front=False`` to fit() for the deterministic Python
+        stream. None = native pass unavailable (caller falls back)."""
+        from deeplearning4j_tpu.nlp.native_text import (NativeSkipGramStream,
+                                                        native_word_counts)
+
+        counts = native_word_counts(path, self.workers)
+        if counts is None:
+            return None
+        self.vocab.fit_from_counts(counts)
+        V, D = len(self.vocab), self.vector_size
+        if V == 0:
+            raise ValueError("empty vocabulary")
+        self.W = ((rng.random((V, D), np.float32) - 0.5) / D)
+        self.C = np.zeros((V, D), np.float32)
+        keep = (self.vocab.subsample_keep_probs(self.subsample)
+                if self.subsample > 0 else None)
+        W, C = jnp.asarray(self.W), jnp.asarray(self.C)
+        if self.hs:
+            freqs = [self.vocab.counts[w_] for w_ in self.vocab.words]
+            codes_m, points_m, mask_m = (jnp.asarray(a)
+                                         for a in build_huffman(freqs))
+            C = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+            accW, accT = jnp.zeros_like(W), jnp.zeros_like(C)
+            probs, negative = None, 0
+        else:
+            probs = self.vocab.unigram_table_probs()
+            aprob, aalias = build_alias_table(probs)
+            aprob, aalias = jnp.asarray(aprob), jnp.asarray(aalias)
+            key = jax.random.PRNGKey(self.seed)
+            tail_sampler = NegativeSampler(probs)
+        # the C++ side ships ONLY (center, context) pairs — negatives are
+        # sampled on-device from the alias table inside the scanned step,
+        # and pair ids ride as uint16 when the vocab fits: 14x fewer
+        # host->device bytes than staging int32 (center, context, negs[K]),
+        # the measured bottleneck under a tunneled PJRT client
+        stream = NativeSkipGramStream(
+            path, self.vocab.words, None, keep, self.window, 0,
+            self.batch_size, seed=self.seed, n_threads=self.workers)
+        # S batches ride each dispatch via the scanned step — per-batch
+        # launch latency amortizes S-fold; the tail shorter than S runs on
+        # the per-batch step with host-sampled negatives. S=32 measured
+        # best on-chip (S=16: 528k, S=32: 619k, S=64+: tail-dominated)
+        S, B = 32, self.batch_size
+        pair_dt = np.uint16 if V <= 0xFFFF else np.int32
+        cs = np.empty((S, B), pair_dt)
+        xs = np.empty((S, B), pair_dt)
+        try:
+            for epoch in range(self.epochs):
+                if epoch:
+                    stream.reset()
+                k = 0
+                for c, x, _ in stream:
+                    cs[k], xs[k] = c, x
+                    k += 1
+                    if k == S:
+                        if self.hs:
+                            W, C, accW, accT, _ = _sg_hs_steps(
+                                W, C, accW, accT, jnp.asarray(cs),
+                                jnp.asarray(xs), codes_m, points_m, mask_m,
+                                lr=self.lr)
+                        else:
+                            key, sub = jax.random.split(key)
+                            W, C, _ = _sg_neg_steps_devneg(
+                                W, C, sub, jnp.asarray(cs), jnp.asarray(xs),
+                                aprob, aalias, lr=self.lr, k=self.negative)
+                        k = 0
+                rng_tail = np.random.default_rng(self.seed + 31 * epoch)
+                for i in range(k):
+                    ci = cs[i].astype(np.int32)
+                    xi = xs[i].astype(np.int32)
+                    if self.hs:
+                        W, C, accW, accT, _ = _sg_hs_step(
+                            W, C, accW, accT, jnp.asarray(ci),
+                            jnp.asarray(xi), codes_m, points_m, mask_m,
+                            lr=self.lr)
+                    else:
+                        negs = tail_sampler.sample(rng_tail,
+                                                   (B, self.negative))
+                        W, C, _ = _sg_neg_step(W, C, jnp.asarray(ci),
+                                               jnp.asarray(xi),
+                                               jnp.asarray(negs),
+                                               lr=self.lr)
+        finally:
+            stream.close()
+        self.W, self.C = np.asarray(W), np.asarray(C)
+        return self
+
+    def fit(self, corpus, chunk_sentences: int = 4096,
+            native_front: Optional[bool] = None) -> "Word2Vec":
         """Two streaming passes per epoch over ``corpus`` (r4): pass 1
         builds the vocabulary sentence-by-sentence; each epoch then streams
         sentences again, encoding + subsampling on the fly and training in
         chunks of ``chunk_sentences`` — the corpus itself is never
         materialized, so file-backed SentenceIterators (nlp.corpus) train
         at any size. Batch shapes are fixed, so every chunk reuses the one
-        compiled XLA step."""
+        compiled XLA step.
+
+        ``native_front``: None (default) auto-selects the native concurrent
+        host pipeline when the corpus is a plain file-backed
+        LineSentenceIterator, the config is skip-gram (neg-sampling or HS)
+        with the default tokenizer, and the native lib loads; True requires
+        it (raising otherwise); False forces the deterministic Python
+        stream."""
         rng = np.random.default_rng(self.seed)
+        if self.hs and self.cbow:
+            raise ValueError("cbow=True with hs=True is not supported; use "
+                             "negative sampling for CBOW")
+        path = (None if native_front is False
+                else self._native_corpus_path(corpus))
+        if native_front is True and path is None:
+            raise ValueError(
+                "native_front=True requires a file-backed "
+                "LineSentenceIterator (no preprocessor, utf-8), a skip-gram "
+                "config with the default tokenizer, and a loadable native "
+                "library")
+        if (native_front is None and path is not None
+                and not self._ascii_sample(path)):
+            # auto mode only routes ASCII corpora natively: tokenization
+            # of non-ASCII text diverges from the Python front (see
+            # _ascii_sample); native_front=True forces it
+            path = None
+        if path is not None:
+            out = self._fit_native(path, rng)
+            if out is not None:
+                return out
         self.vocab.fit(self._iter_token_sents(corpus))
         V, D = len(self.vocab), self.vector_size
         if V == 0:
@@ -258,9 +489,6 @@ class Word2Vec:
                 if self.subsample > 0 else None)
 
         W, C = jnp.asarray(self.W), jnp.asarray(self.C)
-        if self.hs and self.cbow:
-            raise ValueError("cbow=True with hs=True is not supported; use "
-                             "negative sampling for CBOW")
         huffman = None
         accW = accT = None
         if self.hs and not self.cbow:

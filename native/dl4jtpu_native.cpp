@@ -11,6 +11,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cerrno>
+#include <unordered_map>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -20,12 +21,15 @@
 #include <map>
 #include <functional>
 #include <dirent.h>
+#include <fcntl.h>
 #include <mutex>
 #include <new>
 #include <random>
 #include <string>
+#include <sys/mman.h>
 #include <sys/stat.h>
 #include <thread>
+#include <unistd.h>
 #include <vector>
 
 extern "C" {
@@ -693,6 +697,449 @@ long dl4j_cache_trim(const char* dir, long cap_bytes) {
     if (std::remove(ent.path.c_str()) == 0) evicted += ent.size;
   }
   return evicted;
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------- text front
+// Concurrent Word2Vec text pipeline (SURVEY.md §2.3 NLP row: the reference's
+// Word2Vec/SequenceVectors trains with PER-THREAD Hogwild workers over the
+// corpus — its host side is inherently concurrent, ~60k LoC of it). TPU-first
+// split: the DEVICE step stays one jitted XLA program (nlp/word2vec.py);
+// this section makes the HOST side concurrent — N threads tokenize, encode,
+// subsample, window and negative-sample line-chunks of the corpus in
+// parallel, delivering fixed-shape (center[B], context[B], negatives[B,K])
+// int32 batches through a bounded queue. Like the reference's Hogwild
+// workers, batch ARRIVAL order is nondeterministic run-to-run (each batch's
+// contents are internally consistent); the pure-Python front in
+// nlp/word2vec.py remains the deterministic path.
+//
+// Tokenizer semantics match nlp.tokenizers.DefaultTokenizerFactory with
+// CommonPreprocessor for ASCII text: lowercase, strip [^\w\s], split on
+// whitespace; one sentence per line. Non-ASCII bytes pass through as word
+// characters without lowercasing (Python's \w matches unicode letters;
+// multibyte UTF-8 sequences survive intact, so ASCII corpora match the
+// Python front token-for-token).
+
+namespace {
+
+// Read-only mmap of the corpus: the file is VIRTUALLY mapped, never
+// materialized in RAM (fit()'s any-corpus-size streaming contract holds —
+// the kernel pages chunks in and out as worker threads touch them).
+// Fallback to a buffered read when mmap fails (or the file is empty).
+struct MappedText {
+  const char* data = nullptr;
+  size_t size = 0;
+  void* mapping = nullptr;
+  std::string fallback;
+
+  bool open_file(const char* path) {
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (::fstat(fd, &st) != 0 || st.st_size < 0) {
+      ::close(fd);
+      return false;
+    }
+    size = static_cast<size_t>(st.st_size);
+    if (size > 0) {
+      void* m = ::mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+      if (m != MAP_FAILED) {
+        mapping = m;
+        data = static_cast<const char*>(m);
+      }
+    }
+    ::close(fd);
+    if (!mapping) {
+      FILE* f = std::fopen(path, "rb");
+      if (!f) return false;
+      fallback.resize(size);
+      size_t got =
+          size ? std::fread(&fallback[0], 1, size, f) : 0;
+      std::fclose(f);
+      if (got != size) return false;
+      data = fallback.data();
+    }
+    return true;
+  }
+
+  MappedText() = default;
+  MappedText(const MappedText&) = delete;
+  MappedText& operator=(const MappedText&) = delete;
+  ~MappedText() {
+    if (mapping) ::munmap(mapping, size);
+  }
+};
+
+struct AsciiTokenizer {
+  const char* p;
+  const char* end;
+  std::string tok;  // reused across next() calls: no per-token allocation
+
+  bool next() {
+    tok.clear();
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p++);
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+          c == '\f') {
+        if (!tok.empty()) return true;
+        continue;
+      }
+      if (c < 128) {
+        if (c >= 'A' && c <= 'Z')
+          tok.push_back(static_cast<char>(c - 'A' + 'a'));
+        else if ((c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') || c == '_')
+          tok.push_back(static_cast<char>(c));
+        // other ASCII: punctuation, stripped ([^\w\s])
+      } else {
+        tok.push_back(static_cast<char>(c));  // UTF-8 byte: word char
+      }
+    }
+    return !tok.empty();
+  }
+};
+
+// line-aligned chunk boundaries: [0, b1, ..., size]; each worker claims one
+// chunk at a time so sentence windows never cross a thread boundary
+void chunk_boundaries(const char* data, size_t size, size_t target,
+                      std::vector<size_t>& out) {
+  out.clear();
+  out.push_back(0);
+  size_t pos = target;
+  while (pos < size) {
+    const void* nl = std::memchr(data + pos, '\n', size - pos);
+    if (!nl) break;
+    size_t b = static_cast<size_t>(static_cast<const char*>(nl) - data) + 1;
+    out.push_back(b);
+    pos = b + target;
+  }
+  out.push_back(size);
+}
+
+inline double u01(std::mt19937_64& rng) {
+  return static_cast<double>(rng() >> 11) * (1.0 / 9007199254740992.0);
+}
+
+// Vose alias table: O(1) negative sampling per draw (the reference's
+// unigram^0.75 table is a 100M-slot array walked with a modulo — same
+// distribution, alias form needs O(V) memory instead)
+struct AliasTable {
+  std::vector<int32_t> alias;
+  std::vector<double> prob;
+
+  void build(const float* probs, long n) {
+    alias.assign(n, 0);
+    prob.assign(n, 1.0);
+    std::vector<double> scaled(n);
+    double total = 0;
+    for (long i = 0; i < n; ++i) total += probs[i];
+    if (total <= 0) total = 1;
+    for (long i = 0; i < n; ++i)
+      scaled[i] = static_cast<double>(probs[i]) / total * n;
+    std::vector<int32_t> small, large;
+    for (long i = 0; i < n; ++i)
+      (scaled[i] < 1.0 ? small : large).push_back(static_cast<int32_t>(i));
+    while (!small.empty() && !large.empty()) {
+      int32_t s = small.back(), l = large.back();
+      small.pop_back();
+      large.pop_back();
+      prob[s] = scaled[s];
+      alias[s] = l;
+      scaled[l] = scaled[l] + scaled[s] - 1.0;
+      (scaled[l] < 1.0 ? small : large).push_back(l);
+    }
+  }
+
+  int32_t sample(std::mt19937_64& rng) const {
+    size_t k = static_cast<size_t>(rng() % alias.size());
+    return u01(rng) < prob[k] ? static_cast<int32_t>(k) : alias[k];
+  }
+};
+
+struct W2vBatch {
+  std::vector<int32_t> center, context, neg;
+};
+
+struct W2vStream {
+  MappedText text;
+  std::vector<size_t> chunks;
+  std::unordered_map<std::string, int32_t> vocab;
+  std::vector<float> keep;  // empty = subsampling off
+  AliasTable neg_table;
+  int window = 5;
+  int negative = 5;
+  long batch = 2048;
+  unsigned seed = 0;
+  int n_threads = 4;
+  int queue_cap = 8;
+  unsigned epoch = 0;
+
+  std::atomic<long> chunk_cursor{0};
+  std::atomic<long> words_seen{0}, pairs_total{0};
+  std::atomic<int> active_workers{0};
+  std::atomic<bool> stop{false};
+  std::deque<W2vBatch> q;
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::vector<std::thread> workers;
+
+  void emit_batches(std::vector<int32_t>& cs, std::vector<int32_t>& xs,
+                    std::mt19937_64& rng, bool flush) {
+    // shuffle the local pair buffer (SGD mixing — the Python front
+    // shuffles per 4096-sentence chunk), then emit full batches; a
+    // non-flush call keeps the tail for the next round
+    size_t n = cs.size();
+    for (size_t i = n - 1; i > 0; --i) {
+      size_t j = static_cast<size_t>(rng() % (i + 1));
+      std::swap(cs[i], cs[j]);
+      std::swap(xs[i], xs[j]);
+    }
+    size_t full = (n / static_cast<size_t>(batch)) * batch;
+    size_t s = 0;
+    for (; s < full; s += batch) {
+      W2vBatch b;
+      b.center.assign(cs.begin() + s, cs.begin() + s + batch);
+      b.context.assign(xs.begin() + s, xs.begin() + s + batch);
+      if (negative > 0) {
+        b.neg.resize(static_cast<size_t>(batch) * negative);
+        for (auto& v : b.neg) v = neg_table.sample(rng);
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_produce.wait(lk, [&] {
+        return stop.load() || q.size() < static_cast<size_t>(queue_cap);
+      });
+      if (stop.load()) return;
+      q.push_back(std::move(b));
+      cv_consume.notify_one();
+    }
+    pairs_total.fetch_add(static_cast<long>(full));
+    cs.erase(cs.begin(), cs.begin() + full);
+    xs.erase(xs.begin(), xs.begin() + full);
+    if (flush) {
+      // epoch tail < batch: dropped, like the Python front's per-chunk
+      // remainder (fixed batch shapes keep the device step compiled once)
+      cs.clear();
+      xs.clear();
+    }
+  }
+
+  void worker(int tid) {
+    std::mt19937_64 rng(seed + 1000003UL * epoch + 7919UL * tid);
+    std::vector<int32_t> ids, cs, xs;
+    long local_words = 0;
+    const size_t flush_at =
+        std::max<size_t>(static_cast<size_t>(4 * batch), 1 << 16);
+    for (;;) {
+      long ci = chunk_cursor.fetch_add(1);
+      if (ci + 1 >= static_cast<long>(chunks.size()) || stop.load()) break;
+      const char* p = text.data + chunks[ci];
+      const char* chunk_end = text.data + chunks[ci + 1];
+      while (p < chunk_end) {
+        const void* nl = std::memchr(p, '\n', chunk_end - p);
+        const char* line_end =
+            nl ? static_cast<const char*>(nl) : chunk_end;
+        ids.clear();
+        AsciiTokenizer tk{p, line_end, {}};
+        while (tk.next()) {
+          auto it = vocab.find(tk.tok);
+          if (it == vocab.end()) continue;
+          ++local_words;
+          if (!keep.empty() && u01(rng) >= keep[it->second]) continue;
+          ids.push_back(it->second);
+        }
+        long n = static_cast<long>(ids.size());
+        for (long i = 0; i < n; ++i) {
+          // uniform window shrink per center, both directions share it
+          // (the Python front's _pairs; Mikolov's dynamic window)
+          long b = 1 + static_cast<long>(rng() % window);
+          for (long d = 1; d <= b; ++d) {
+            if (i >= d) {
+              cs.push_back(ids[i]);
+              xs.push_back(ids[i - d]);
+            }
+            if (i + d < n) {
+              cs.push_back(ids[i]);
+              xs.push_back(ids[i + d]);
+            }
+          }
+        }
+        if (cs.size() >= flush_at) emit_batches(cs, xs, rng, false);
+        p = line_end + (nl ? 1 : 0);
+      }
+    }
+    if (!cs.empty() && !stop.load()) emit_batches(cs, xs, rng, true);
+    words_seen.fetch_add(local_words);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      active_workers.fetch_sub(1);
+      cv_consume.notify_all();
+    }
+  }
+
+  void start() {
+    stop.store(false);
+    chunk_cursor.store(0);
+    active_workers.store(n_threads);
+    for (int t = 0; t < n_threads; ++t)
+      workers.emplace_back([this, t] { this->worker(t); });
+  }
+
+  void join() {
+    stop.store(true);
+    cv_produce.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+    std::lock_guard<std::mutex> lk(mu);
+    q.clear();
+  }
+};
+
+struct WordCounts {
+  std::unordered_map<std::string, long> counts;
+  long total_bytes = 0;  // dump-buffer size (incl. NUL)
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- vocabulary pass: multithreaded word counting over line chunks
+void* dl4j_wc_create(const char* path, int n_threads) {
+  auto* wc = new WordCounts();
+  MappedText text;
+  if (!text.open_file(path)) {
+    delete wc;
+    return nullptr;
+  }
+  int nt = n_threads > 0 ? n_threads : 4;
+  std::vector<size_t> chunks;
+  chunk_boundaries(text.data, text.size,
+                   std::max<size_t>(text.size / (4 * nt) + 1, 1 << 16),
+                   chunks);
+  std::atomic<long> cursor{0};
+  std::mutex merge_mu;
+  auto work = [&]() {
+    std::unordered_map<std::string, long> local;
+    for (;;) {
+      long ci = cursor.fetch_add(1);
+      if (ci + 1 >= static_cast<long>(chunks.size())) break;
+      AsciiTokenizer tk{text.data + chunks[ci], text.data + chunks[ci + 1],
+                        {}};
+      while (tk.next()) ++local[tk.tok];
+    }
+    std::lock_guard<std::mutex> lk(merge_mu);
+    for (auto& kv : local) wc->counts[kv.first] += kv.second;
+  };
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+  for (auto& kv : wc->counts)
+    wc->total_bytes += static_cast<long>(kv.first.size()) + 24;
+  wc->total_bytes += 1;
+  return wc;
+}
+
+long dl4j_wc_bytes(void* handle) {
+  return static_cast<WordCounts*>(handle)->total_bytes;
+}
+
+// "word count\n" per entry (arbitrary order; caller sorts)
+void dl4j_wc_dump(void* handle, char* out) {
+  auto* wc = static_cast<WordCounts*>(handle);
+  char* p = out;
+  for (auto& kv : wc->counts) {
+    std::memcpy(p, kv.first.data(), kv.first.size());
+    p += kv.first.size();
+    p += std::snprintf(p, 24, " %ld\n", kv.second);
+  }
+  *p = '\0';
+}
+
+void dl4j_wc_destroy(void* handle) { delete static_cast<WordCounts*>(handle); }
+
+// ---- training stream: vocab_blob is '\n'-joined words in index order;
+// probs [V] is the unigram^0.75 negative-sampling distribution (ignored
+// when negative == 0); keep [V] is the subsample keep-probability table or
+// NULL. Workers start immediately; one epoch per start, reset() rewinds.
+void* dl4j_w2v_create(const char* path, const char* vocab_blob, long vocab_n,
+                      const float* probs, const float* keep, int window,
+                      int negative, long batch, unsigned seed, int n_threads,
+                      int queue_cap) {
+  if (vocab_n <= 0 || window <= 0 || batch <= 0) return nullptr;
+  auto* st = new W2vStream();
+  if (!st->text.open_file(path)) {
+    delete st;
+    return nullptr;
+  }
+  const char* p = vocab_blob;
+  for (long i = 0; i < vocab_n; ++i) {
+    const char* nl = std::strchr(p, '\n');
+    if (!nl) {
+      if (i != vocab_n - 1 || !*p) {
+        delete st;
+        return nullptr;
+      }
+      nl = p + std::strlen(p);
+    }
+    st->vocab.emplace(std::string(p, nl), static_cast<int32_t>(i));
+    p = nl + 1;
+  }
+  if (keep) st->keep.assign(keep, keep + vocab_n);
+  st->window = window;
+  st->negative = negative > 0 ? negative : 0;
+  if (st->negative > 0) st->neg_table.build(probs, vocab_n);
+  st->batch = batch;
+  st->seed = seed;
+  st->n_threads = n_threads > 0 ? n_threads : 4;
+  st->queue_cap = queue_cap > 0 ? queue_cap : 8;
+  chunk_boundaries(st->text.data, st->text.size,
+                   std::max<size_t>(st->text.size / (4 * st->n_threads) + 1,
+                                    1 << 16),
+                   st->chunks);
+  st->start();
+  return st;
+}
+
+// 0 = batch delivered (center[B], context[B], neg[B*K]); 1 = epoch done
+int dl4j_w2v_next(void* handle, int32_t* center, int32_t* context,
+                  int32_t* neg) {
+  auto* st = static_cast<W2vStream*>(handle);
+  std::unique_lock<std::mutex> lk(st->mu);
+  st->cv_consume.wait(lk, [&] {
+    return !st->q.empty() || st->active_workers.load() == 0;
+  });
+  if (st->q.empty()) return 1;
+  W2vBatch b = std::move(st->q.front());
+  st->q.pop_front();
+  st->cv_produce.notify_one();
+  lk.unlock();
+  std::memcpy(center, b.center.data(), b.center.size() * sizeof(int32_t));
+  std::memcpy(context, b.context.data(), b.context.size() * sizeof(int32_t));
+  if (!b.neg.empty())
+    std::memcpy(neg, b.neg.data(), b.neg.size() * sizeof(int32_t));
+  return 0;
+}
+
+void dl4j_w2v_reset(void* handle) {
+  auto* st = static_cast<W2vStream*>(handle);
+  st->join();
+  st->epoch += 1;  // fresh window-shrink/negative draws per epoch
+  st->start();
+}
+
+long dl4j_w2v_words(void* handle) {
+  return static_cast<W2vStream*>(handle)->words_seen.load();
+}
+
+long dl4j_w2v_pairs(void* handle) {
+  return static_cast<W2vStream*>(handle)->pairs_total.load();
+}
+
+void dl4j_w2v_destroy(void* handle) {
+  auto* st = static_cast<W2vStream*>(handle);
+  st->join();
+  delete st;
 }
 
 }  // extern "C"

@@ -88,3 +88,11 @@ def test_bert_mlm():
 
     first, last = bert_mlm.main(steps=40)
     assert np.isfinite(last) and last < first
+
+
+def test_word2vec_native():
+    import word2vec_native
+
+    w2v = word2vec_native.main(n_lines=1500, vector_size=32, epochs=2)
+    # in-topic similarity beats cross-topic on the two-topic corpus
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "market")
