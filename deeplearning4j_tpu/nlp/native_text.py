@@ -98,24 +98,30 @@ class NativeSkipGramStream:
         self._x = np.empty(batch, np.int32)
         self._n = np.empty((batch, max(negative, 1)), np.int32)
 
+    def _handle(self):
+        if not self._h:   # NULL through ctypes would segfault the C side
+            raise RuntimeError("NativeSkipGramStream is closed")
+        return self._h
+
     def __iter__(self):
         cp = self._c.ctypes.data_as(_I32P)
         xp = self._x.ctypes.data_as(_I32P)
         np_ = self._n.ctypes.data_as(_I32P)
-        while self._lib.dl4j_w2v_next(self._h, cp, xp, np_) == 0:
+        h = self._handle()
+        while self._lib.dl4j_w2v_next(h, cp, xp, np_) == 0:
             yield (self._c, self._x,
                    self._n if self.negative > 0 else None)
 
     def reset(self):
-        self._lib.dl4j_w2v_reset(self._h)
+        self._lib.dl4j_w2v_reset(self._handle())
 
     @property
     def words_seen(self) -> int:
-        return int(self._lib.dl4j_w2v_words(self._h))
+        return int(self._lib.dl4j_w2v_words(self._handle()))
 
     @property
     def pairs_emitted(self) -> int:
-        return int(self._lib.dl4j_w2v_pairs(self._h))
+        return int(self._lib.dl4j_w2v_pairs(self._handle()))
 
     def close(self):
         if self._h:

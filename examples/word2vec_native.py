@@ -29,13 +29,17 @@ def make_corpus(path: str, n_lines: int = 4000, seed: int = 0):
 
 def main(n_lines: int = 4000, vector_size: int = 64, epochs: int = 3,
          workers: int = 0, seed: int = 1):
-    path = os.path.join(tempfile.gettempdir(), "w2v_corpus.txt")
+    fd, path = tempfile.mkstemp(suffix=".txt", prefix="w2v_corpus_")
+    os.close(fd)      # unique per run: concurrent runs must not share it
     make_corpus(path, n_lines, seed)
 
-    w2v = Word2Vec(vector_size=vector_size, window=3, min_count=2,
-                   negative=5, epochs=epochs, batch_size=256,
-                   learning_rate=0.005, workers=workers, seed=seed)
-    w2v.fit(LineSentenceIterator(path))     # auto-selects the native front
+    try:
+        w2v = Word2Vec(vector_size=vector_size, window=3, min_count=2,
+                       negative=5, epochs=epochs, batch_size=256,
+                       learning_rate=0.005, workers=workers, seed=seed)
+        w2v.fit(LineSentenceIterator(path))  # auto-selects the native front
+    finally:
+        os.unlink(path)
 
     print(f"vocab: {len(w2v.vocab)} words")
     for a, b in [("cat", "dog"), ("cat", "market"), ("stock", "share")]:

@@ -934,7 +934,10 @@ struct W2vStream {
       if (ci + 1 >= static_cast<long>(chunks.size()) || stop.load()) break;
       const char* p = text.data + chunks[ci];
       const char* chunk_end = text.data + chunks[ci + 1];
-      while (p < chunk_end) {
+      // stop is re-checked per line, not only per chunk: with few threads
+      // a chunk can span hundreds of MB, and destroy() must not wait for
+      // a worker to finish tokenizing one
+      while (p < chunk_end && !stop.load(std::memory_order_relaxed)) {
         const void* nl = std::memchr(p, '\n', chunk_end - p);
         const char* line_end =
             nl ? static_cast<const char*>(nl) : chunk_end;

@@ -771,3 +771,21 @@ class TestNativeTextFront:
         # the raw bytes un-lowercased only for non-ASCII, but the point
         # is the route; vocab content is the witness)
         assert "café" in w2v.vocab.index
+
+    def test_closed_stream_raises_instead_of_segfaulting(self, tmp_path):
+        from deeplearning4j_tpu.nlp.native_text import NativeSkipGramStream
+
+        p = tmp_path / "c.txt"
+        p.write_text("a b c d e\n" * 5)
+        s = NativeSkipGramStream(str(p), ["a", "b", "c", "d", "e"],
+                                 np.ones(5, np.float32) / 5, None,
+                                 window=2, negative=2, batch=4, seed=1,
+                                 n_threads=2)
+        s.close()
+        s.close()                      # idempotent
+        with pytest.raises(RuntimeError, match="closed"):
+            s.reset()
+        with pytest.raises(RuntimeError, match="closed"):
+            _ = s.words_seen
+        with pytest.raises(RuntimeError, match="closed"):
+            next(iter(s))
