@@ -1722,10 +1722,10 @@ def main():
     # timeout can never lose the north-star line. Ordered by artifact
     # value on a slow-tunnel session (an r5 session watched the main lane
     # eat ~400 s of the 520 s budget and truncate everything after smoke):
-    # smoke (capped — it must not starve the rest) -> bert_import +
-    # serving + nlp (the r5 asks) -> kernels table (self-truncating) ->
-    # input pipeline -> remeasure -> quick configs. block_secs records
-    # where the budget actually went.
+    # smoke (capped — it must not starve the rest) -> bert_import (+
+    # at-scale) -> serving -> nlp -> quick lenet/lstm configs -> kernels
+    # table (self-truncating) -> input pipeline -> remeasure. block_secs
+    # records where the budget actually went.
     block_secs = {"north_star": round(time.perf_counter()
                                       - (deadline - float(
                                           os.environ.get(

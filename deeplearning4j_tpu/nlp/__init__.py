@@ -22,10 +22,15 @@ from deeplearning4j_tpu.nlp.vocab import VocabCache
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serializer import (
+    load_word2vec, read_word_vectors, save_word2vec, write_word_vectors,
+)
 
 __all__ = ["DefaultTokenizerFactory", "NGramTokenizerFactory", "VocabCache",
            "Word2Vec", "Glove", "ParagraphVectors",
            "BasicLineIterator", "CollectionSentenceIterator",
            "FileLabelAwareIterator", "FileSentenceIterator",
            "LabelledDocument", "LineSentenceIterator", "PhraseDetector",
-           "SentencePreProcessor", "BertIterator", "BertWordPieceTokenizer"]
+           "SentencePreProcessor", "BertIterator", "BertWordPieceTokenizer",
+           "write_word_vectors", "read_word_vectors", "save_word2vec",
+           "load_word2vec"]

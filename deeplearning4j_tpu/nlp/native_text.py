@@ -107,8 +107,9 @@ class NativeSkipGramStream:
         cp = self._c.ctypes.data_as(_I32P)
         xp = self._x.ctypes.data_as(_I32P)
         np_ = self._n.ctypes.data_as(_I32P)
-        h = self._handle()
-        while self._lib.dl4j_w2v_next(h, cp, xp, np_) == 0:
+        # re-read the handle every iteration: close() between next() calls
+        # must raise, not hand a freed pointer to the C side
+        while self._lib.dl4j_w2v_next(self._handle(), cp, xp, np_) == 0:
             yield (self._c, self._x,
                    self._n if self.negative > 0 else None)
 

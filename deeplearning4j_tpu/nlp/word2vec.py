@@ -43,7 +43,7 @@ def cbow_windows(encoded, window: int):
             np.asarray(ctxs, np.int32).reshape(-1, 2 * window))
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
+@functools.partial(jax.jit, donate_argnums=(0, 1))
 def _sg_neg_step(W, C, center, context, negatives, lr):
     """One negative-sampling SGD step.
 
@@ -65,7 +65,7 @@ def _sg_neg_step(W, C, center, context, negatives, lr):
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("lr", "k"))
+                   static_argnames=("k",))
 def _sg_neg_steps_devneg(W, C, key, centers, contexts, aprob, aalias, lr, k):
     """S sequential negative-sampling steps in ONE dispatch: centers [S, B]
     and contexts [S, B] scanned over axis 0, so one host->device transfer
@@ -104,7 +104,7 @@ def _sg_neg_steps_devneg(W, C, key, centers, contexts, aprob, aalias, lr, k):
     return W, C, losses.sum()
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=("lr",))
+@functools.partial(jax.jit, donate_argnums=(0, 1))
 def _cbow_neg_step(W, C, context_win, center, negatives, lr):
     """CBOW: mean of context window vectors predicts the center word.
     context_win [B, 2w] (padded with center index where window clipped)."""
@@ -172,7 +172,7 @@ def build_huffman(freqs) -> tuple:
     return code_m, point_m, mask_m
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnames=("lr",))
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def _sg_hs_step(W, Theta, accW, accT, center, context, codes, points, mask, lr):
     """Hierarchical-softmax skip-gram step with Adagrad scaling.
 
@@ -204,8 +204,7 @@ def _sg_hs_step(W, Theta, accW, accT, center, context, codes, points, mask, lr):
     return W, Theta, accW, accT, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3),
-                   static_argnames=("lr",))
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def _sg_hs_steps(W, Theta, accW, accT, centers, contexts, codes, points,
                  mask, lr):
     """S sequential hierarchical-softmax steps in one dispatch (the scan
@@ -246,8 +245,13 @@ class Word2Vec:
                  min_count: int = 1, negative: int = 5, epochs: int = 1,
                  learning_rate: float = 0.025, cbow: bool = False,
                  subsample: float = 0.0, batch_size: int = 512, seed: int = 42,
-                 hs: bool = False, workers: int = 0):
+                 hs: bool = False, workers: int = 0,
+                 min_learning_rate: Optional[float] = None):
         self.vector_size = vector_size
+        # linear lr decay over the run's words, floored here (reference:
+        # Word2Vec.Builder().minLearningRate — its alpha decays with words
+        # processed). None keeps the fixed-lr behavior.
+        self.min_lr = min_learning_rate
         self.window = window
         self.negative = negative
         self.hs = hs
@@ -346,6 +350,16 @@ class Word2Vec:
             head = f.read(limit)
         return not head or max(head) < 0x80
 
+    def _lr_at(self, words_done: int, total_words: int) -> float:
+        """Linear lr decay over the run's in-vocab words (the reference's
+        alpha schedule), floored at min_learning_rate; fixed lr when the
+        floor is unset. lr rides the jitted steps as a traced operand, so
+        the per-chunk value never recompiles."""
+        if self.min_lr is None:
+            return self.lr
+        frac = min(1.0, words_done / max(1, total_words))
+        return max(self.min_lr, self.lr * (1.0 - frac))
+
     def _fit_native(self, path: str, rng) -> Optional["Word2Vec"]:
         """Train over the native concurrent text front: N C++ threads
         tokenize/encode/subsample/window/negative-sample line-chunks in
@@ -388,6 +402,7 @@ class Word2Vec:
         # and pair ids ride as uint16 when the vocab fits: 14x fewer
         # host->device bytes than staging int32 (center, context, negs[K]),
         # the measured bottleneck under a tunneled PJRT client
+        total_words = self.vocab._total * self.epochs
         stream = NativeSkipGramStream(
             path, self.vocab.words, None, keep, self.window, 0,
             self.batch_size, seed=self.seed, n_threads=self.workers)
@@ -408,18 +423,20 @@ class Word2Vec:
                     cs[k], xs[k] = c, x
                     k += 1
                     if k == S:
+                        lr_now = self._lr_at(stream.words_seen, total_words)
                         if self.hs:
                             W, C, accW, accT, _ = _sg_hs_steps(
                                 W, C, accW, accT, jnp.asarray(cs),
                                 jnp.asarray(xs), codes_m, points_m, mask_m,
-                                lr=self.lr)
+                                lr=lr_now)
                         else:
                             key, sub = jax.random.split(key)
                             W, C, _ = _sg_neg_steps_devneg(
                                 W, C, sub, jnp.asarray(cs), jnp.asarray(xs),
-                                aprob, aalias, lr=self.lr, k=self.negative)
+                                aprob, aalias, lr=lr_now, k=self.negative)
                         k = 0
                 rng_tail = np.random.default_rng(self.seed + 31 * epoch)
+                lr_now = self._lr_at(stream.words_seen, total_words)
                 for i in range(k):
                     ci = cs[i].astype(np.int32)
                     xi = xs[i].astype(np.int32)
@@ -427,14 +444,14 @@ class Word2Vec:
                         W, C, accW, accT, _ = _sg_hs_step(
                             W, C, accW, accT, jnp.asarray(ci),
                             jnp.asarray(xi), codes_m, points_m, mask_m,
-                            lr=self.lr)
+                            lr=lr_now)
                     else:
                         negs = tail_sampler.sample(rng_tail,
                                                    (B, self.negative))
                         W, C, _ = _sg_neg_step(W, C, jnp.asarray(ci),
                                                jnp.asarray(xi),
                                                jnp.asarray(negs),
-                                               lr=self.lr)
+                                               lr=lr_now)
         finally:
             stream.close()
         self.W, self.C = np.asarray(W), np.asarray(C)
@@ -499,7 +516,7 @@ class Word2Vec:
             accW = jnp.zeros_like(W)
             accT = jnp.zeros_like(C)
 
-        def train_chunk(encoded):
+        def train_chunk(encoded, lr):
             nonlocal W, C, accW, accT
             if self.cbow:
                 centers, ctxs = cbow_windows(encoded, self.window)
@@ -512,7 +529,7 @@ class Word2Vec:
                     negs = sampler.sample(rng, (B, self.negative))
                     W, C, _ = _cbow_neg_step(W, C, jnp.asarray(ctxs[s:s + B]),
                                              jnp.asarray(centers[s:s + B]),
-                                             jnp.asarray(negs), lr=self.lr)
+                                             jnp.asarray(negs), lr=lr)
             elif self.hs:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:
@@ -525,7 +542,7 @@ class Word2Vec:
                     W, C, accW, accT, _ = _sg_hs_step(
                         W, C, accW, accT, jnp.asarray(batch[:, 0]),
                         jnp.asarray(batch[:, 1]),
-                        codes_m, points_m, mask_m, lr=self.lr)
+                        codes_m, points_m, mask_m, lr=lr)
             else:
                 pairs = self._pairs(encoded, rng)
                 if len(pairs) == 0:
@@ -543,8 +560,10 @@ class Word2Vec:
                     W, C, _ = _sg_neg_step(W, C, jnp.asarray(batch[:, 0]),
                                            jnp.asarray(batch[:, 1]),
                                            jnp.asarray(negs_all[k]),
-                                           lr=self.lr)
+                                           lr=lr)
 
+        total_words = self.vocab._total * self.epochs
+        words_done = 0
         for epoch in range(self.epochs):
             if hasattr(corpus, "reset"):
                 corpus.reset()
@@ -553,15 +572,16 @@ class Word2Vec:
             for toks in self._iter_token_sents(corpus):
                 seen += 1
                 enc = self.vocab.encode(toks)
+                words_done += len(enc)
                 if keep is not None and len(enc):
                     enc = enc[rng.random(len(enc)) < keep[enc]]
                 if len(enc):
                     buf.append(enc)
                 if len(buf) >= chunk_sentences:
-                    train_chunk(buf)
+                    train_chunk(buf, self._lr_at(words_done, total_words))
                     buf = []
             if buf:
-                train_chunk(buf)
+                train_chunk(buf, self._lr_at(words_done, total_words))
             if seen == 0 and epoch == 0:
                 # a single-pass generator was exhausted by the vocabulary
                 # pass — fail loud instead of returning random embeddings

@@ -951,6 +951,14 @@ struct W2vStream {
           ids.push_back(it->second);
         }
         long n = static_cast<long>(ids.size());
+        if (local_words) {
+          // publish per line, not per worker-exit: consumers poll this
+          // counter DURING the epoch (the Word2Vec alpha schedule decays
+          // lr by words processed); a relaxed add per line is noise next
+          // to tokenization cost
+          words_seen.fetch_add(local_words, std::memory_order_relaxed);
+          local_words = 0;
+        }
         for (long i = 0; i < n; ++i) {
           // uniform window shrink per center, both directions share it
           // (the Python front's _pairs; Mikolov's dynamic window)

@@ -744,6 +744,18 @@ class TestNativeTextFront:
         with pytest.raises(ValueError, match="native_front=True"):
             Word2Vec(vector_size=8).fit(CORPUS, native_front=True)
 
+    def test_native_front_with_lr_decay(self, tmp_path):
+        from deeplearning4j_tpu.nlp.corpus import LineSentenceIterator
+
+        p = tmp_path / "corpus.txt"
+        p.write_text("\n".join(CORPUS))
+        w2v = Word2Vec(vector_size=16, window=3, negative=4, epochs=6,
+                       batch_size=64, learning_rate=0.02,
+                       min_learning_rate=0.001, seed=7)
+        w2v.fit(LineSentenceIterator(str(p)), native_front=True)
+        assert np.isfinite(w2v.W).all()
+        assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "market")
+
     def test_python_fallback_forced_and_deterministic(self, tmp_path):
         from deeplearning4j_tpu.nlp.corpus import LineSentenceIterator
 
@@ -789,3 +801,108 @@ class TestNativeTextFront:
             _ = s.words_seen
         with pytest.raises(RuntimeError, match="closed"):
             next(iter(s))
+
+    def test_close_during_iteration_raises(self, tmp_path):
+        from deeplearning4j_tpu.nlp.native_text import NativeSkipGramStream
+
+        p = tmp_path / "c.txt"
+        p.write_text("a b c d e f g h\n" * 400)
+        s = NativeSkipGramStream(str(p), list("abcdefgh"),
+                                 np.ones(8, np.float32) / 8, None,
+                                 window=2, negative=2, batch=16, seed=1,
+                                 n_threads=2)
+        it = iter(s)
+        next(it)
+        s.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            next(it)
+
+    def test_words_seen_advances_mid_epoch(self, tmp_path):
+        """The native counter must publish DURING the epoch (per line, not
+        per worker-exit) — the lr decay schedule polls it between
+        superbatches."""
+        from deeplearning4j_tpu.nlp.native_text import NativeSkipGramStream
+
+        p = tmp_path / "c.txt"
+        p.write_text("a b c d e f g h\n" * 2000)
+        s = NativeSkipGramStream(str(p), list("abcdefgh"),
+                                 np.ones(8, np.float32) / 8, None,
+                                 window=2, negative=2, batch=64, seed=1,
+                                 n_threads=2, queue_cap=2)
+        it = iter(s)
+        for _ in range(3):
+            next(it)
+        seen_early = s.words_seen
+        assert seen_early > 0
+        n_rest = sum(1 for _ in it)
+        assert n_rest > 0
+        assert s.words_seen == 16000
+        s.close()
+
+
+class TestWordVectorSerializer:
+    """r5: the original word2vec interchange formats (reference:
+    WordVectorSerializer text + binary) — what makes embeddings portable
+    to/from gensim/fastText/the C tool."""
+
+    def _fitted(self):
+        return Word2Vec(vector_size=12, window=2, epochs=2, batch_size=64,
+                        seed=3).fit(CORPUS)
+
+    def test_text_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import load_word2vec, save_word2vec
+
+        w2v = self._fitted()
+        p = str(tmp_path / "vecs.txt")
+        save_word2vec(w2v, p)
+        # header + one line per word
+        lines = open(p).read().splitlines()
+        assert lines[0] == f"{len(w2v.vocab)} 12"
+        back = load_word2vec(p)
+        assert back.vocab.words == w2v.vocab.words
+        np.testing.assert_allclose(back.W, w2v.W, rtol=1e-4, atol=1e-5)
+        # queries work on the loaded model
+        assert back.words_nearest("cat", top=3) == w2v.words_nearest(
+            "cat", top=3)
+
+    def test_binary_round_trip_exact(self, tmp_path):
+        from deeplearning4j_tpu.nlp import load_word2vec, save_word2vec
+
+        w2v = self._fitted()
+        p = str(tmp_path / "vecs.bin")
+        save_word2vec(w2v, p, binary=True)
+        back = load_word2vec(p, binary=True)
+        assert back.vocab.words == w2v.vocab.words
+        np.testing.assert_array_equal(back.W, w2v.W)  # f32 bit-exact
+
+    def test_headerless_text_tolerated(self, tmp_path):
+        from deeplearning4j_tpu.nlp import read_word_vectors
+
+        p = tmp_path / "noheader.txt"
+        p.write_text("alpha 1 2 3\nbeta 4 5 6\n")
+        words, W = read_word_vectors(str(p))
+        assert words == ["alpha", "beta"]
+        np.testing.assert_array_equal(W, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_min_learning_rate_linear_decay():
+    """r5: the reference's alpha schedule — lr decays linearly with words
+    processed, floored at min_learning_rate; decay must not recompile the
+    step (lr rides as a traced operand)."""
+    w2v = Word2Vec(vector_size=8, learning_rate=0.02,
+                   min_learning_rate=0.005)
+    w2v.vocab._total = 1000
+    w2v.epochs = 1
+    assert w2v._lr_at(0, 1000) == pytest.approx(0.02)
+    assert w2v._lr_at(500, 1000) == pytest.approx(0.01)
+    assert w2v._lr_at(950, 1000) == pytest.approx(0.005)   # floored
+    assert w2v._lr_at(2000, 1000) == pytest.approx(0.005)  # clamped frac
+    # unset floor keeps the fixed-lr behavior
+    fixed = Word2Vec(vector_size=8, learning_rate=0.02)
+    assert fixed._lr_at(500, 1000) == 0.02
+
+    # end-to-end: decaying fit still learns and stays finite
+    m = Word2Vec(vector_size=16, window=2, epochs=4, batch_size=64, seed=7,
+                 learning_rate=0.02, min_learning_rate=0.001).fit(CORPUS)
+    assert np.isfinite(m.W).all()
+    assert m.similarity("cat", "dog") > m.similarity("cat", "market")

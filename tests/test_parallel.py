@@ -657,7 +657,11 @@ class TestRingFlashCore:
         from deeplearning4j_tpu.parallel.sequence import ring_attention
 
         mesh = DeviceMesh(data=1, seq=8)
-        B, H, T, D = 1, 2, 512, 128
+        # shapes sized for the CPU interpreter (H=2/T=512 cost ~110 s per
+        # variant and added no block-coverage over T=256: t_local=32 is
+        # still multi-row, multi-ring-step); at-scale shapes run in the
+        # driver dryrun and the on-chip longcontext bench
+        B, H, T, D = 1, 1, 256, 128
         q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
@@ -852,7 +856,10 @@ class TestZigzagRing:
         from deeplearning4j_tpu.parallel.sequence import ring_attention_zigzag
 
         mesh = DeviceMesh(data=1, seq=8)
-        B, H, T, D = 1, 2, 512, 128
+        # interpreter-sized (was H=2/T=512 at ~550 s): T=256 still gives
+        # 16-row zigzag stripes and 2 visible tiles/device/step — the
+        # balance property under test is shape-independent beyond that
+        B, H, T, D = 1, 1, 256, 128
         q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
@@ -942,7 +949,7 @@ class TestZigzagAtScale:
                                                  zigzag_shard, zigzag_unshard)
 
         mesh = DeviceMesh(data=1, seq=8)
-        B, H, T, D = 1, 2, 256, 128
+        B, H, T, D = 1, 1, 256, 128
         q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
@@ -964,7 +971,7 @@ class TestZigzagAtScale:
         from deeplearning4j_tpu.parallel import (sequence_parallel_encoder,
                                                  zigzag_shard, zigzag_unshard)
 
-        Hh, D, T, B = 2, 256, 128, 1
+        Hh, D, T, B = 1, 128, 128, 1
         layer = TransformerEncoderLayer(d_model=D, n_heads=Hh, causal=True)
         params, state = layer.init(_jax.random.key(0),
                                    InputType.recurrent(D, T))
