@@ -106,3 +106,13 @@ class Glove:
 
     def similarity(self, a: str, b: str) -> float:
         return cosine_similarity(self.get_word_vector(a), self.get_word_vector(b))
+
+    def words_nearest(self, word=None, top: int = 10, positive=None,
+                      negative=None):
+        """wordsNearest over the summed W+C GloVe vectors (single-word and
+        analogy forms, shared engine with Word2Vec)."""
+        from deeplearning4j_tpu.nlp.vocab import nearest_neighbors
+
+        return nearest_neighbors(self.vocab.words, self.vocab.index, self.W,
+                                 word=word, top=top, positive=positive,
+                                 negative=negative)

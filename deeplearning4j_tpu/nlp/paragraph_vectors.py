@@ -146,3 +146,16 @@ class ParagraphVectors:
 
     def similarity(self, a: str, b: str) -> float:
         return cosine_similarity(self.get_doc_vector(a), self.get_doc_vector(b))
+
+    def nearest_labels(self, text: str, top: int = 10):
+        """nearestLabels — infer a vector for raw text and return the
+        closest trained document labels by cosine (the reference's
+        ParagraphVectors.nearestLabels(rawText, topN))."""
+        v = self.infer_vector(text)
+        n = np.linalg.norm(v)
+        if n == 0 or len(self.labels) == 0:
+            return []
+        Dn = self.doc_vectors / np.maximum(
+            np.linalg.norm(self.doc_vectors, axis=1, keepdims=True), 1e-12)
+        sims = Dn @ (v / n)
+        return [self.labels[j] for j in np.argsort(-sims)][:top]

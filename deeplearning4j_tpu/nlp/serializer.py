@@ -78,25 +78,41 @@ def read_word_vectors(path: str,
                 words.append(w.decode("utf-8", errors="replace").lstrip("\n"))
             return words, np.vstack(rows).astype(np.float32)
     words, rows = [], []
-    D = None
+    V = D = None
     with open(path, "r", encoding="utf-8", errors="replace") as f:
-        first = f.readline()
+        first = ""
+        while not first.strip():        # tolerate leading blank lines
+            first = f.readline()
+            if not first:
+                raise ValueError(f"{path}: empty word-vector file")
         parts = first.split()
         if len(parts) == 2 and all(p.isdigit() for p in parts):
-            D = int(parts[1])           # proper "V D" header
+            V, D = int(parts[0]), int(parts[1])   # "V D" header
         else:                           # headerless: first line is data
             words.append(parts[0])
             rows.append(np.asarray([float(v) for v in parts[1:]], np.float32))
             D = len(parts) - 1
-        for line in f:
-            parts = line.rstrip("\n").split(" ")
+        for lineno, line in enumerate(f, 2):
+            parts = line.split()        # any whitespace separates fields
+            if not parts:
+                continue                # blank line
             if len(parts) < D + 1:
-                continue
+                raise ValueError(
+                    f"{path}:{lineno}: expected a word + {D} floats, got "
+                    f"{len(parts)} fields")
             # words may contain spaces in some exports: floats are the
             # LAST D fields, the word is everything before them
             words.append(" ".join(parts[:-D]))
             rows.append(np.asarray([float(v) for v in parts[-D:]],
                                    np.float32))
+    if V is not None and len(words) != V:
+        # also catches the ambiguous case of a headerless file whose
+        # first line happened to look like a "V D" header
+        raise ValueError(
+            f"{path}: header declares {V} vectors but {len(words)} data "
+            f"lines were read")
+    if not rows:
+        raise ValueError(f"{path}: no word vectors found")
     return words, np.vstack(rows)
 
 

@@ -116,6 +116,32 @@ class NegativeSampler:
         return np.searchsorted(self._cdf, rng.random(size)).astype(np.int32)
 
 
+def nearest_neighbors(words: List[str], index: dict, W: np.ndarray,
+                      word: Optional[str] = None, top: int = 10,
+                      positive=None, negative=None) -> List[str]:
+    """Shared wordsNearest engine (Word2Vec/GloVe; reference:
+    wordsNearest(word | positive, negative, top)): cosine neighbors of a
+    word or of a mean(positive) - mean(negative) analogy query, excluding
+    the query words. [] on any OOV query word."""
+    positive = list(positive or ([] if word is None else [word]))
+    negative = list(negative or [])
+    if word is not None and positive and word not in positive:
+        positive = [word] + positive
+    if not positive:      # negatives alone have no defined query direction
+        return []
+    idx = [index.get(w, -1) for w in positive + negative]
+    if any(i < 0 for i in idx):
+        return []
+    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+    n_pos = len(positive)
+    q = Wn[idx[:n_pos]].mean(axis=0)
+    if negative:
+        q = q - Wn[idx[n_pos:]].mean(axis=0)
+    sims = Wn @ (q / max(np.linalg.norm(q), 1e-12))
+    exclude = set(idx)
+    return [words[j] for j in np.argsort(-sims) if j not in exclude][:top]
+
+
 def cosine_similarity(a: Optional[np.ndarray], b: Optional[np.ndarray]) -> float:
     """Shared cosine helper (Word2Vec/Glove/ParagraphVectors .similarity)."""
     if a is None or b is None:

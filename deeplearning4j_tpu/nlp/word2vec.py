@@ -423,6 +423,12 @@ class Word2Vec:
                     cs[k], xs[k] = c, x
                     k += 1
                     if k == S:
+                        # PRODUCER-side schedule, like the reference: the
+                        # original word2vec decays alpha by words READ per
+                        # thread, and our C++ workers publish exactly that
+                        # counter. It runs ahead of applied updates by the
+                        # worker-buffer/queue lead (bounded; negligible on
+                        # real corpora, up to an epoch on tiny ones)
                         lr_now = self._lr_at(stream.words_seen, total_words)
                         if self.hs:
                             W, C, accW, accT, _ = _sg_hs_steps(
@@ -601,15 +607,16 @@ class Word2Vec:
     def similarity(self, a: str, b: str) -> float:
         return cosine_similarity(self.get_word_vector(a), self.get_word_vector(b))
 
-    def words_nearest(self, word: str, top: int = 10) -> List[str]:
-        """wordsNearest — cosine neighbors."""
-        i = self.vocab.index_of(word)
-        if i < 0:
-            return []
-        Wn = self.W / np.maximum(np.linalg.norm(self.W, axis=1, keepdims=True), 1e-12)
-        sims = Wn @ Wn[i]
-        order = np.argsort(-sims)
-        return [self.vocab.words[j] for j in order if j != i][:top]
+    def words_nearest(self, word=None, top: int = 10, positive=None,
+                      negative=None) -> List[str]:
+        """wordsNearest — cosine neighbors of a word, or of an analogy
+        query (reference: wordsNearest(positive, negative, top), the
+        king - man + woman form)."""
+        from deeplearning4j_tpu.nlp.vocab import nearest_neighbors
+
+        return nearest_neighbors(self.vocab.words, self.vocab.index, self.W,
+                                 word=word, top=top, positive=positive,
+                                 negative=negative)
 
     # ----------------------------------------------------------------- serde
     def save(self, path: str):

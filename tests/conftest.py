@@ -49,3 +49,15 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """LRU-trim the persistent compile cache so suite reruns cannot grow
+    it without bound (the native dl4j_cache_trim; no-op without the
+    native lib or under a missing directory)."""
+    try:
+        from deeplearning4j_tpu.native.lib import trim_compile_cache
+
+        trim_compile_cache(_cache_dir, cap_bytes=2 << 30)
+    except Exception:
+        pass

@@ -884,6 +884,72 @@ class TestWordVectorSerializer:
         assert words == ["alpha", "beta"]
         np.testing.assert_array_equal(W, [[1, 2, 3], [4, 5, 6]])
 
+    def test_text_reader_fails_loud_on_malformed_input(self, tmp_path):
+        from deeplearning4j_tpu.nlp import read_word_vectors
+
+        # leading blank lines tolerated; tabs/double spaces tolerated
+        p = tmp_path / "messy.txt"
+        p.write_text("\n\n2 3\nalpha\t1 2  3\nbeta 4 5 6\n")
+        words, W = read_word_vectors(str(p))
+        assert words == ["alpha", "beta"]
+        # header/data mismatch raises (also catches data misread as header)
+        bad = tmp_path / "bad.txt"
+        bad.write_text("3 3\nalpha 1 2 3\n")
+        with pytest.raises(ValueError, match="declares 3"):
+            read_word_vectors(str(bad))
+        # short line raises with its line number, never silently drops
+        short = tmp_path / "short.txt"
+        short.write_text("2 3\nalpha 1 2 3\nbeta 4 5\n")
+        with pytest.raises(ValueError, match="short.txt:3"):
+            read_word_vectors(str(short))
+        # empty file
+        empty = tmp_path / "empty.txt"
+        empty.write_text("\n")
+        with pytest.raises(ValueError, match="empty"):
+            read_word_vectors(str(empty))
+
+
+def test_words_nearest_analogy_form():
+    """r5: wordsNearest(positive, negative, top) — the analogy query form.
+    On a synthetic corpus with a clean pairing structure, b - a + c must
+    rank d first when (a, b) and (c, d) co-occur in parallel roles."""
+    rng = np.random.default_rng(4)
+    # two "relation" pairs: (paris, france) and (rome, italy) appear in
+    # identical frames; distractor topics fill the rest
+    lines = []
+    for _ in range(300):
+        lines.append("paris is the capital of france")
+        lines.append("rome is the capital of italy")
+        lines.append("cats and dogs play in gardens")
+    w2v = Word2Vec(vector_size=24, window=3, negative=4, epochs=10,
+                   learning_rate=0.01, batch_size=128, seed=2).fit(lines)
+    near = w2v.words_nearest(positive=["france", "rome"],
+                             negative=["paris"], top=3)
+    assert "italy" in near, near
+    # single-word form unchanged
+    assert w2v.words_nearest("paris", top=5)
+    # OOV in the query -> empty, not a crash
+    assert w2v.words_nearest(positive=["nosuchword"]) == []
+    # negatives alone have no query direction -> empty, not NaN garbage
+    assert w2v.words_nearest(negative=["paris"]) == []
+
+
+def test_glove_words_nearest_and_pv_nearest_labels():
+    gl = Glove(vector_size=16, window=3, epochs=150, learning_rate=0.05,
+               x_max=10, seed=5).fit(CORPUS)
+    near = gl.words_nearest("stocks", top=4)
+    assert len(near) == 4 and "stocks" not in near
+    assert gl.words_nearest(positive=["nosuchword"]) == []
+
+    docs = (["the cat sat with the dog on the mat"] * 4
+            + ["stocks rallied as the market closed higher"] * 4)
+    labels = [f"animal_{i}" if i < 4 else f"fin_{i}" for i in range(8)]
+    pv = ParagraphVectors(vector_size=24, window=3, negative=4, epochs=30,
+                          learning_rate=0.08, seed=11).fit(docs, labels)
+    near = pv.nearest_labels("the cat and the dog played", top=3)
+    assert len(near) == 3
+    assert near[0].startswith("animal"), near
+
 
 def test_min_learning_rate_linear_decay():
     """r5: the reference's alpha schedule — lr decays linearly with words
