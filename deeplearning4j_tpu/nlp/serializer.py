@@ -81,8 +81,10 @@ def read_word_vectors(path: str,
     V = D = None
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         first = ""
+        consumed = 0
         while not first.strip():        # tolerate leading blank lines
             first = f.readline()
+            consumed += 1
             if not first:
                 raise ValueError(f"{path}: empty word-vector file")
         parts = first.split()
@@ -92,7 +94,7 @@ def read_word_vectors(path: str,
             words.append(parts[0])
             rows.append(np.asarray([float(v) for v in parts[1:]], np.float32))
             D = len(parts) - 1
-        for lineno, line in enumerate(f, 2):
+        for lineno, line in enumerate(f, consumed + 1):
             parts = line.split()        # any whitespace separates fields
             if not parts:
                 continue                # blank line
@@ -102,9 +104,14 @@ def read_word_vectors(path: str,
                     f"{len(parts)} fields")
             # words may contain spaces in some exports: floats are the
             # LAST D fields, the word is everything before them
+            try:
+                row = np.asarray([float(v) for v in parts[-D:]], np.float32)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: last {D} fields must be floats "
+                    f"({e})") from None
             words.append(" ".join(parts[:-D]))
-            rows.append(np.asarray([float(v) for v in parts[-D:]],
-                                   np.float32))
+            rows.append(row)
     if V is not None and len(words) != V:
         # also catches the ambiguous case of a headerless file whose
         # first line happened to look like a "V D" header

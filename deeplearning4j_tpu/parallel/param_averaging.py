@@ -52,13 +52,24 @@ class ParameterAveragingTrainer:
 
     def __init__(self, loss_fn: Callable, updater, mesh, *,
                  axis: str = "data", averaging_frequency: int = 1,
-                 average_updater_state: bool = True, stateful: bool = False):
+                 average_updater_state: bool = True, stateful: bool = False,
+                 max_grad_norm: float = 0.0, skip_average=None):
         from deeplearning4j_tpu.optimize.updaters import get_updater
 
         self.loss_fn = loss_fn
         self.updater = get_updater(updater)
         self.mesh = mesh
         self.axis = axis
+        # global-norm gradient clipping inside each LOCAL step, mirroring
+        # the fit path's conf.max_grad_norm (r5); 0 = off
+        self.max_grad_norm = float(max_grad_norm)
+        # top-level param entries (MLN layer list / CG vertex dict, bools
+        # aligned with the entries) whose averaging collective is SKIPPED
+        # (r5): frozen entries never diverge, so averaging them wastes
+        # collective bytes — and on the virtual-CPU test mesh XLA's
+        # scan+psum rewrite costs 1 ulp even over identical replicas,
+        # which would wiggle params that must stay bit-identical
+        self.skip_average = skip_average
         if int(averaging_frequency) < 1:
             raise ValueError(f"averaging_frequency must be >= 1, got "
                              f"{averaging_frequency}")
@@ -87,10 +98,14 @@ class ParameterAveragingTrainer:
         return carry
 
     def _build(self, carry, batch_keys):
+        from deeplearning4j_tpu.nn.multilayer import global_norm_clip
+
         loss_fn, updater = self.loss_fn, self.updater
         axis = self.axis
         avg_opt = self.average_updater_state
         stateful = self.stateful
+        max_gn = self.max_grad_norm
+        skip = self.skip_average
         has_mask = "mask" in batch_keys
         has_lmask = "label_mask" in batch_keys
 
@@ -132,6 +147,8 @@ class ParameterAveragingTrainer:
                 else:
                     p, o, i = state
                     loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+                if max_gn > 0:
+                    g = global_norm_clip(g, max_gn)
                 upd, o2 = updater.update(g, o, p, i)
                 p2 = jax.tree_util.tree_map(lambda a, d: a - d, p, upd)
                 if stateful:
@@ -146,9 +163,20 @@ class ParameterAveragingTrainer:
                 (params, opt, step), losses = lax.scan(
                     local_step, (params, opt, carry["step"]), batch)
             # the round's single collective: average the diverged replicas
-            params = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), params)
+            # (frozen entries pass through untouched — see skip_average)
+            def avg_tree(tree):
+                pm = lambda t: jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, axis), t)
+                if skip is None:
+                    return pm(tree)
+                if isinstance(tree, dict):
+                    return {k: (tree[k] if skip.get(k) else pm(tree[k]))
+                            for k in tree}
+                return [t if s else pm(t) for t, s in zip(tree, skip)]
+
+            params = avg_tree(params)
             if avg_opt:
-                opt = jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), opt)
+                opt = avg_tree(opt)
             out = {"params": jax.tree_util.tree_map(lambda t: t[None], params),
                    "opt": jax.tree_util.tree_map(lambda t: t[None], opt),
                    "step": step}

@@ -118,11 +118,29 @@ class SparkDl4jMultiLayer:
 
         self._check_local_sgd_supported(K)
         # r4: the stateful functional surface — BN running stats and the
-        # dropout rng thread through, so those configs train here now
+        # dropout rng thread through, so those configs train here now.
+        # r5: the trainer carries the NETWORK'S OWN updater selection
+        # (NoOp for frozen layers, per-layer overrides, global default)
+        # via PerEntryUpdater, plus conf.max_grad_norm clipping — so
+        # transfer-learning configs and clipped models train here too
+        from deeplearning4j_tpu.optimize.updaters import PerEntryUpdater
+
         loss_fn, (params0, state0) = self.network.as_loss_fn(train=True)
+        net_ups = self.network._updaters
+        per_entry = (dict(net_ups) if isinstance(net_ups, dict)
+                     else list(net_ups))
+        from deeplearning4j_tpu.optimize.updaters import NoOp
+
+        # frozen entries never diverge: skip their averaging collective
+        # so they stay bit-identical through local SGD
+        skip = ({k: isinstance(u, NoOp) for k, u in per_entry.items()}
+                if isinstance(per_entry, dict)
+                else [isinstance(u, NoOp) for u in per_entry])
         trainer = ParameterAveragingTrainer(
-            loss_fn, self.network.conf.updater, self._wrapper.mesh.mesh,
-            averaging_frequency=K, stateful=True)
+            loss_fn, PerEntryUpdater(per_entry), self._wrapper.mesh.mesh,
+            averaging_frequency=K, stateful=True,
+            max_grad_norm=getattr(self.network.conf, "max_grad_norm", 0.0),
+            skip_average=skip)
         carry = trainer.init(params0, state=state0,
                              rng=self.network._next_key())
         # one averaging round consumes K global batches; the accumulator
@@ -189,17 +207,17 @@ class SparkDl4jMultiLayer:
     def _check_local_sgd_supported(self, K):
         """The K>1 path optimizes the model through its FUNCTIONAL loss
         (as_loss_fn). r4: that surface threads (state, rng) and includes
-        l1/l2 terms, so BatchNorm, dropout and regularization train here
-        now — the reference master averages any model. What remains
-        rejected is what the single-global-updater trainer genuinely
-        cannot express: per-layer updater overrides, frozen layers,
-        gradient clipping, center loss, and multi-input/-output graphs
-        (the round batch plumbing carries one features/labels pair)."""
+        l1/l2 terms, so BatchNorm, dropout and regularization train here.
+        r5: the trainer carries the network's per-entry updater selection
+        (PerEntryUpdater: NoOp for frozen layers, per-layer overrides)
+        and conf.max_grad_norm clipping, so transfer-learning and clipped
+        configs train here too. What remains rejected is what the round
+        plumbing genuinely cannot express: center loss (centers state and
+        the center term live in the fit path) and multi-input/-output
+        graphs (the round batch carries one features/labels pair)."""
         net = self.network
         conf = net.conf
         problems = []
-        if getattr(conf, "max_grad_norm", 0):
-            problems.append("gradient clipping (max_grad_norm)")
         if hasattr(net, "layers"):           # MultiLayerNetwork
             named = [(str(i), l) for i, l in enumerate(net.layers)]
         else:                                # ComputationGraph
@@ -211,10 +229,6 @@ class SparkDl4jMultiLayer:
             named = [(n, v.layer) for n, v in conf.vertices.items()
                      if isinstance(v, LayerVertex)]
         for i, l in named:
-            if not l.trainable:
-                problems.append(f"layer {i} frozen (trainable=False)")
-            if l.updater is not None:
-                problems.append(f"layer {i} per-layer updater override")
             if type(l).__name__ == "CenterLossOutputLayer":
                 problems.append(f"layer {i} center loss (centers state "
                                 "and center term need the fit path)")

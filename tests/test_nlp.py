@@ -907,13 +907,22 @@ class TestWordVectorSerializer:
         empty.write_text("\n")
         with pytest.raises(ValueError, match="empty"):
             read_word_vectors(str(empty))
+        # non-float field where a vector component belongs: named line
+        nf = tmp_path / "nf.txt"
+        nf.write_text("1 3\nnew york 1 2\n")
+        with pytest.raises(ValueError, match="nf.txt:2.*floats"):
+            read_word_vectors(str(nf))
+        # line numbers stay physical when leading blanks were skipped
+        lb = tmp_path / "lb.txt"
+        lb.write_text("\n\n2 3\nalpha 1 2 3\nbeta 4 5\n")
+        with pytest.raises(ValueError, match="lb.txt:5"):
+            read_word_vectors(str(lb))
 
 
 def test_words_nearest_analogy_form():
     """r5: wordsNearest(positive, negative, top) — the analogy query form.
     On a synthetic corpus with a clean pairing structure, b - a + c must
     rank d first when (a, b) and (c, d) co-occur in parallel roles."""
-    rng = np.random.default_rng(4)
     # two "relation" pairs: (paris, france) and (rome, italy) appear in
     # identical frames; distractor topics fill the rest
     lines = []

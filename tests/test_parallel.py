@@ -1123,10 +1123,12 @@ class TestSparkLocalSgdRouting:
             False)
         assert moved, "BN running stats did not flow back after local SGD"
 
-    def test_unsupported_configs_rejected_loudly(self, rng):
-        """What the single-global-updater trainer genuinely cannot express
-        (frozen layers, per-layer updaters, clipping, center loss) is
-        still refused loudly."""
+    def test_frozen_and_per_layer_updaters_train_on_local_sgd(self, rng):
+        """r5: PerEntryUpdater carries the network's own updater selection
+        onto the functional trainer — frozen layers stay bit-identical
+        while the rest trains, and per-layer overrides apply (reference:
+        the master averages transfer-learned models like any other)."""
+        from deeplearning4j_tpu.optimize import Adam
         from deeplearning4j_tpu.parallel.spark import (
             ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
 
@@ -1134,6 +1136,8 @@ class TestSparkLocalSgdRouting:
                 .list()
                 .layer(DenseLayer(n_out=8, activation="relu",
                                   trainable=False))
+                .layer(DenseLayer(n_out=8, activation="relu",
+                                  updater=Adam(lr=0.01)))
                 .layer(OutputLayer(n_out=4, activation="softmax",
                                    loss="mcxent"))
                 .set_input_type(InputType.feed_forward(8)).build())
@@ -1141,7 +1145,63 @@ class TestSparkLocalSgdRouting:
               .batch_size_per_worker(8).averaging_frequency(4).build())
         x, y, it = self._data(rng, n=256)
         spark = SparkDl4jMultiLayer(DeviceMesh(data=8), conf, tm)
-        with pytest.raises(NotImplementedError, match="frozen"):
+        net = spark.network
+        frozen_before = jax.tree_util.tree_map(np.asarray, net.params[0])
+        middle_before = jax.tree_util.tree_map(np.asarray, net.params[1])
+        l0 = net.score((x, y))
+        spark.fit(it, epochs=8)
+        l1 = net.score((x, y))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            frozen_before, net.params[0])     # frozen: bit-identical
+        moved = jax.tree_util.tree_reduce(
+            lambda a, b: a or b,
+            jax.tree_util.tree_map(
+                lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+                middle_before, net.params[1]), False)
+        assert moved, "per-layer-updater layer did not train"
+
+    def test_grad_clipping_trains_on_local_sgd(self, rng):
+        """r5: conf.max_grad_norm rides the local steps (global-norm clip
+        before the per-entry update, mirroring the fit path)."""
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(lr=0.1))
+                .gradient_clipping(1.0).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        x, y, it = self._data(rng, n=256)
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8), conf, tm)
+        l0 = spark.network.score((x, y))
+        spark.fit(it, epochs=8)
+        l1 = spark.network.score((x, y))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+    def test_unsupported_configs_rejected_loudly(self, rng):
+        """What the round plumbing genuinely cannot express (center loss)
+        is still refused loudly."""
+        from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(lr=0.1))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(CenterLossOutputLayer(n_out=4, activation="softmax",
+                                             loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        x, y, it = self._data(rng, n=256)
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8), conf, tm)
+        with pytest.raises(NotImplementedError, match="center loss"):
             spark.fit(it, epochs=1)
 
     def test_uneven_tail_dropped_with_warning(self, rng):
