@@ -214,18 +214,26 @@ class ParameterAveragingTrainer:
     def fit_round(self, carry, x, y, mask=None, label_mask=None):
         """One full averaging round over a global batch.
 
-        x/y: [K * global_batch, ...] — split into K sequential microbatches;
-        each replica sees K local shards, steps K times locally, then the
-        single parameter average runs. ``mask``/``label_mask`` (r5):
-        optional [K * global_batch, T] masks riding the same split — the
-        stateful as_loss_fn surface normalizes each local step by its
-        shard's valid count. Returns (carry, mean loss)."""
+        x/y: [K * global_batch, ...] arrays — or dicts of them (r5: the
+        ComputationGraph multi-input/-output shape; every leaf shares the
+        batch axis) — split into K sequential microbatches; each replica
+        sees K local shards, steps K times locally, then the single
+        parameter average runs. ``mask``/``label_mask`` (r5): optional
+        [K * global_batch, T] masks riding the same split — the stateful
+        as_loss_fn surface normalizes each local step by its shard's
+        valid count (single-input/-output only). Returns (carry, loss)."""
         import numpy as np
 
         if (mask is not None or label_mask is not None) and not self.stateful:
             raise ValueError(
                 "masked batches need stateful=True (the as_loss_fn surface "
                 "that takes (mask, label_mask))")
+        multi = isinstance(x, dict) or isinstance(y, dict)
+        if multi and (mask is not None or label_mask is not None):
+            raise ValueError(
+                "masked batches are not supported with dict (multi-input/"
+                "-output) rounds; fit the graph directly for masked "
+                "MultiDataSets")
         K = self.freq
         dp = self.mesh.shape[self.axis]
         denom = None
@@ -241,19 +249,25 @@ class ParameterAveragingTrainer:
             denom = jnp.asarray(
                 np.maximum(nm.reshape(K, -1).sum(axis=1), 1.0) / dp,
                 jnp.float32)
-        batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+        batch = {"x": jax.tree_util.tree_map(jnp.asarray, x),
+                 "y": jax.tree_util.tree_map(jnp.asarray, y)}
         if mask is not None:
             batch["mask"] = jnp.asarray(mask)
         if label_mask is not None:
             batch["label_mask"] = jnp.asarray(label_mask)
-        n = batch["x"].shape[0]
+        n = jax.tree_util.tree_leaves(batch["x"])[0].shape[0]
+        for leaf in jax.tree_util.tree_leaves((batch["x"], batch["y"])):
+            if leaf.shape[0] != n:
+                raise ValueError(
+                    f"every x/y slot must share the batch axis: got "
+                    f"{leaf.shape[0]} rows vs {n}")
         if n % K:
             raise ValueError(f"batch {n} not divisible into {K} local steps")
         if (n // K) % dp:
             raise ValueError(f"per-step batch {n // K} not "
                              f"divisible by data-parallel degree {dp}")
-        batch = {k: v.reshape((K, n // K) + v.shape[1:])
-                 for k, v in batch.items()}
+        batch = jax.tree_util.tree_map(
+            lambda v: v.reshape((K, n // K) + v.shape[1:]), batch)
         if denom is not None:
             batch["denom"] = denom
         keys = frozenset(batch)

@@ -148,39 +148,61 @@ class SparkDl4jMultiLayer:
         # than K batches per epoch — rounds must still complete, exactly
         # like the reference master carrying its iteration count across
         # RDD passes)
-        xs, ys, ms, lms, have = [], [], [], [], 0
-        dropped_tail = 0
-        for _ in range(epochs):
-            for ds in _RebatchingIterator(data, global_batch, dp):
-                if ds.features.shape[0] != global_batch:
-                    # rounds reshape into K x (global_batch/dp) microbatch
-                    # shards; a truncated tail would mis-shard the whole
-                    # round, so it is dropped (counted + warned below)
-                    dropped_tail += ds.features.shape[0]
-                    continue
-                # r5: masked DataSets ride the rounds — as_loss_fn takes
-                # (mask, label_mask) and normalizes each local step by its
-                # shard's valid count. _unpack gives fit_batch's canonical
-                # routing (a labels-only mask plays both roles); the
-                # rebatcher enforces an all-masked-or-none stream, so
-                # presence is uniform across rounds
-                x_, y_, m_, lm_ = _unpack(ds)
-                xs.append(np.asarray(x_))
-                ys.append(np.asarray(y_))
-                if m_ is not None:
-                    ms.append(np.asarray(m_))
-                if lm_ is not None:
-                    lms.append(np.asarray(lm_))
-                have += 1
-                if have == K:
-                    carry, loss = trainer.fit_round(
-                        carry, np.concatenate(xs), np.concatenate(ys),
-                        mask=np.concatenate(ms) if ms else None,
-                        label_mask=np.concatenate(lms) if lms else None)
-                    self.network.score_value = float(loss)
-                    xs, ys, ms, lms, have = [], [], [], [], 0
-            if hasattr(data, "reset"):
-                data.reset()
+        conf = self.network.conf
+        # the multi path serves ComputationGraphs fed MultiDataSets —
+        # dispatch on the STREAM's shape, not just graph arity (a
+        # 1-in/1-out graph legitimately trains from MultiDataSet RDDs in
+        # the reference's SparkComputationGraph, and the DataSet rebatcher
+        # would silently mis-shard its list-of-arrays features)
+        multi = False
+        if not hasattr(self.network, "layers"):     # ComputationGraph
+            multi = (len(conf.network_inputs) > 1
+                     or len(conf.network_outputs) > 1)
+            if not multi:
+                peek = next(iter(data), None)
+                multi = isinstance(getattr(peek, "features", None),
+                                   (list, tuple, dict))
+                if hasattr(data, "reset"):
+                    data.reset()
+        if multi:
+            carry, have, dropped_tail = self._run_multi_rounds(
+                data, epochs, global_batch, K, trainer, carry)
+        else:
+            xs, ys, ms, lms, have = [], [], [], [], 0
+            dropped_tail = 0
+            for _ in range(epochs):
+                for ds in _RebatchingIterator(data, global_batch, dp):
+                    if ds.features.shape[0] != global_batch:
+                        # rounds reshape into K x (global_batch/dp)
+                        # microbatch shards; a truncated tail would
+                        # mis-shard the whole round, so it is dropped
+                        # (counted + warned below)
+                        dropped_tail += ds.features.shape[0]
+                        continue
+                    # r5: masked DataSets ride the rounds — as_loss_fn
+                    # takes (mask, label_mask) and normalizes each local
+                    # step by its shard's valid count. _unpack gives
+                    # fit_batch's canonical routing (a labels-only mask
+                    # plays both roles); the rebatcher enforces an
+                    # all-masked-or-none stream, so presence is uniform
+                    # across rounds
+                    x_, y_, m_, lm_ = _unpack(ds)
+                    xs.append(np.asarray(x_))
+                    ys.append(np.asarray(y_))
+                    if m_ is not None:
+                        ms.append(np.asarray(m_))
+                    if lm_ is not None:
+                        lms.append(np.asarray(lm_))
+                    have += 1
+                    if have == K:
+                        carry, loss = trainer.fit_round(
+                            carry, np.concatenate(xs), np.concatenate(ys),
+                            mask=np.concatenate(ms) if ms else None,
+                            label_mask=np.concatenate(lms) if lms else None)
+                        self.network.score_value = float(loss)
+                        xs, ys, ms, lms, have = [], [], [], [], 0
+                if hasattr(data, "reset"):
+                    data.reset()
         if have or dropped_tail:
             warnings.warn(
                 f"local-SGD fit dropped {have} trailing batch(es) that did "
@@ -204,6 +226,80 @@ class SparkDl4jMultiLayer:
                 u.init_state(p) for u, p in zip(ups, self.network.params)]
         return self.network
 
+    def _run_multi_rounds(self, data, epochs, global_batch, K, trainer,
+                          carry):
+        """r5: MULTI-input/-output ComputationGraph local SGD (reference:
+        SparkComputationGraph trains MultiDataSet RDDs). The stream's
+        MultiDataSets are pooled per slot and re-cut into global batches;
+        each round ships dict x/y keyed by the graph's input/output names
+        through the same trainer (fit_round accepts pytrees). Masked
+        MultiDataSets are rejected with guidance — multi-output mask
+        routing lives in the fit path. Returns (carry, pending_batches,
+        dropped_rows)."""
+        import numpy as np
+
+        conf = self.network.conf
+        in_names = list(conf.network_inputs)
+        out_names = list(conf.network_outputs)
+        pool_x = [[] for _ in in_names]
+        pool_y = [[] for _ in out_names]
+        pooled = 0
+        round_x, round_y, have = [], [], 0
+
+        def slots(arrs, names, what):
+            if isinstance(arrs, dict):
+                return [np.asarray(arrs[n]) for n in names]
+            arrs = list(arrs)
+            if len(arrs) != len(names):
+                raise ValueError(f"MultiDataSet carries {len(arrs)} {what} "
+                                 f"arrays; the graph has {len(names)}")
+            return [np.asarray(a) for a in arrs]
+
+        def pop_global_batch():
+            nonlocal pooled
+            cx = [np.concatenate(p) if len(p) > 1 else p[0] for p in pool_x]
+            cy = [np.concatenate(p) if len(p) > 1 else p[0] for p in pool_y]
+            for i, a in enumerate(cx):
+                pool_x[i] = [a[global_batch:]]
+            for i, a in enumerate(cy):
+                pool_y[i] = [a[global_batch:]]
+            pooled -= global_batch
+            return ([a[:global_batch] for a in cx],
+                    [a[:global_batch] for a in cy])
+
+        for _ in range(epochs):
+            for ds in data:
+                if (getattr(ds, "features_mask", None) is not None
+                        or getattr(ds, "labels_mask", None) is not None):
+                    raise NotImplementedError(
+                        "masked MultiDataSets are not supported on the "
+                        "local-SGD path; fit the ComputationGraph "
+                        "directly (fit_batch routes per-output masks)")
+                fa = slots(ds.features, in_names, "feature")
+                la = slots(ds.labels, out_names, "label")
+                for i, a in enumerate(fa):
+                    pool_x[i].append(a)
+                for i, a in enumerate(la):
+                    pool_y[i].append(a)
+                pooled += fa[0].shape[0]
+                while pooled >= global_batch:
+                    gx, gy = pop_global_batch()
+                    round_x.append(gx)
+                    round_y.append(gy)
+                    have += 1
+                    if have == K:
+                        x_dict = {n: np.concatenate([r[i] for r in round_x])
+                                  for i, n in enumerate(in_names)}
+                        y_dict = {n: np.concatenate([r[i] for r in round_y])
+                                  for i, n in enumerate(out_names)}
+                        carry, loss = trainer.fit_round(carry, x_dict,
+                                                        y_dict)
+                        self.network.score_value = float(loss)
+                        round_x, round_y, have = [], [], 0
+            if hasattr(data, "reset"):
+                data.reset()
+        return carry, have, pooled
+
     def _check_local_sgd_supported(self, K):
         """The K>1 path optimizes the model through its FUNCTIONAL loss
         (as_loss_fn). r4: that surface threads (state, rng) and includes
@@ -211,10 +307,11 @@ class SparkDl4jMultiLayer:
         r5: the trainer carries the network's per-entry updater selection
         (PerEntryUpdater: NoOp for frozen layers, per-layer overrides)
         and conf.max_grad_norm clipping, so transfer-learning and clipped
-        configs train here too. What remains rejected is what the round
-        plumbing genuinely cannot express: center loss (centers state and
-        the center term live in the fit path) and multi-input/-output
-        graphs (the round batch carries one features/labels pair)."""
+        configs train here too; multi-input/-output graphs ride dict
+        rounds (_run_multi_rounds). What remains rejected is center loss
+        (centers state and the center term live in the fit path) and
+        MASKED MultiDataSets (multi-output mask routing lives in the fit
+        path)."""
         net = self.network
         conf = net.conf
         problems = []
@@ -223,9 +320,6 @@ class SparkDl4jMultiLayer:
         else:                                # ComputationGraph
             from deeplearning4j_tpu.nn.conf.graph import LayerVertex
 
-            if len(conf.network_inputs) != 1 or \
-                    len(conf.network_outputs) != 1:
-                problems.append("multiple graph inputs/outputs")
             named = [(n, v.layer) for n, v in conf.vertices.items()
                      if isinstance(v, LayerVertex)]
         for i, l in named:

@@ -1184,6 +1184,144 @@ class TestSparkLocalSgdRouting:
         l1 = spark.network.score((x, y))
         assert np.isfinite(l1) and l1 < l0, (l0, l1)
 
+    def test_multi_input_output_graph_on_local_sgd(self, rng):
+        """r5: SparkComputationGraph analog — a 2-input/2-output graph
+        trains at averaging_frequency>1 from a MultiDataSet stream (the
+        reference's SparkComputationGraph + MultiDataSet RDDs); dict
+        rounds flow through the same trainer."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(lr=0.05)).graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(**{"a": InputType.feed_forward(3),
+                                    "b": InputType.feed_forward(5)})
+                .add_layer("fa", DenseLayer(n_out=8, activation="relu"), "a")
+                .add_layer("fb", DenseLayer(n_out=8, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "fa", "fb")
+                .add_layer("o1", OutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "m")
+                .add_layer("o2", OutputLayer(n_out=1, activation="identity",
+                                             loss="mse"), "m")
+                .set_outputs("o1", "o2")
+                .build())
+        n = 256
+        a = rng.normal(size=(n, 3)).astype(np.float32)
+        b = rng.normal(size=(n, 5)).astype(np.float32)
+        cls = (a[:, 0] + b[:, 0] > 0).astype(np.int64)
+        y1 = np.eye(2, dtype=np.float32)[cls]
+        y2 = (a[:, :1] - b[:, :1]).astype(np.float32)
+
+        class _Stream:
+            def __iter__(self):
+                mds = MultiDataSet([a, b], [y1, y2])
+                return iter(mds.batches(64))
+
+            def reset(self):
+                pass
+
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                    ComputationGraph(conf).init(), tm)
+        net = spark.network
+        l0 = float(net.score(MultiDataSet([a, b], [y1, y2])))
+        spark.fit(_Stream(), epochs=16)
+        l1 = float(net.score(MultiDataSet([a, b], [y1, y2])))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+        out1 = np.asarray(net.output({"a": a, "b": b})[0])
+        assert (out1.argmax(1) == cls).mean() > 0.7
+
+    def test_single_io_graph_with_multidataset_stream(self, rng):
+        """A 1-input/1-output ComputationGraph fed a MultiDataSet stream
+        (the reference's SparkComputationGraph shape) must route through
+        the multi path — the DataSet rebatcher would mis-shard its
+        list-of-arrays features."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(lr=0.1)).graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.feed_forward(8)})
+                .add_layer("d", DenseLayer(n_out=8, activation="relu"),
+                           "in")
+                .add_layer("o", OutputLayer(n_out=4, activation="softmax",
+                                            loss="mcxent"), "d")
+                .set_outputs("o")
+                .build())
+        x = rng.normal(size=(256, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 256)]
+
+        class _Stream:
+            def __iter__(self):
+                return iter(MultiDataSet([x], [y]).batches(64))
+
+            def reset(self):
+                pass
+
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(4).build())
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                    ComputationGraph(conf).init(), tm)
+        net = spark.network
+        l0 = float(net.score((x, y)))
+        spark.fit(_Stream(), epochs=12)
+        l1 = float(net.score((x, y)))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+    def test_masked_multidataset_rejected_on_local_sgd(self, rng):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+        from deeplearning4j_tpu.nn.layers import (GravesLSTMLayer,
+                                                  RnnOutputLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(lr=0.05)).graph_builder()
+                .add_inputs("s", "t")
+                .set_input_types(**{"s": InputType.recurrent(2, None),
+                                    "t": InputType.recurrent(2, None)})
+                .add_layer("ls", GravesLSTMLayer(n_out=4,
+                                                 activation="tanh"), "s")
+                .add_layer("lt", GravesLSTMLayer(n_out=4,
+                                                 activation="tanh"), "t")
+                .add_layer("o1", RnnOutputLayer(n_out=2,
+                                                activation="softmax",
+                                                loss="mcxent"), "ls")
+                .add_layer("o2", RnnOutputLayer(n_out=2,
+                                                activation="softmax",
+                                                loss="mcxent"), "lt")
+                .set_outputs("o1", "o2")
+                .build())
+        s = rng.normal(size=(64, 6, 2)).astype(np.float32)
+        y = np.zeros((64, 6, 2), np.float32)
+        y[..., 0] = 1.0
+        m = np.ones((64, 6), np.float32)
+
+        class _Stream:
+            def __iter__(self):
+                return iter(MultiDataSet([s, s], [y, y],
+                                         features_mask=m).batches(32))
+
+            def reset(self):
+                pass
+
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(4).averaging_frequency(4).build())
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                    ComputationGraph(conf).init(), tm)
+        with pytest.raises(NotImplementedError, match="masked MultiDataSet"):
+            spark.fit(_Stream(), epochs=1)
+
     def test_unsupported_configs_rejected_loudly(self, rng):
         """What the round plumbing genuinely cannot express (center loss)
         is still refused loudly."""
