@@ -228,12 +228,6 @@ class ParameterAveragingTrainer:
             raise ValueError(
                 "masked batches need stateful=True (the as_loss_fn surface "
                 "that takes (mask, label_mask))")
-        multi = isinstance(x, dict) or isinstance(y, dict)
-        if multi and (mask is not None or label_mask is not None):
-            raise ValueError(
-                "masked batches are not supported with dict (multi-input/"
-                "-output) rounds; fit the graph directly for masked "
-                "MultiDataSets")
         K = self.freq
         dp = self.mesh.shape[self.axis]
         denom = None

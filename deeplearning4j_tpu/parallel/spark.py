@@ -101,10 +101,37 @@ class SparkDl4jMultiLayer:
         global_batch = self.training_master.batch_size_per_worker * dp
         K = int(self.training_master.averaging_frequency)
         if K <= 1:
-            self._wrapper.fit(_RebatchingIterator(data, global_batch, dp),
+            # a MultiDataSet stream needs the slot-aware rebatcher — the
+            # DataSet one would np.asarray a LIST of feature arrays into
+            # a stacked mess (r5)
+            multi, data = self._peek_multi(data)
+            rebatcher = (_RebatchingMultiIterator if multi
+                         else _RebatchingIterator)
+            self._wrapper.fit(rebatcher(data, global_batch, dp),
                               epochs=epochs)
             return self.network
         return self._fit_local_sgd(data, epochs, global_batch, dp, K)
+
+    def _peek_multi(self, data):
+        """(is_multidataset_stream, stream) — peeks the first item of a
+        ComputationGraph stream without losing it: resettable sources are
+        reset; one-shot generators get the peeked item stitched back
+        (MultiLayerNetwork streams can never be multi, so they are not
+        peeked at all)."""
+        if hasattr(self.network, "layers"):          # MultiLayerNetwork
+            return False, data
+        it = iter(data)
+        peek = next(it, None)
+        multi = isinstance(getattr(peek, "features", None),
+                           (list, tuple, dict))
+        if hasattr(data, "reset"):
+            data.reset()
+            return multi, data
+        if peek is None:
+            return multi, data
+        import itertools
+
+        return multi, itertools.chain([peek], it)
 
     def _fit_local_sgd(self, data, epochs, global_batch, dp, K):
         import warnings
@@ -159,11 +186,7 @@ class SparkDl4jMultiLayer:
             multi = (len(conf.network_inputs) > 1
                      or len(conf.network_outputs) > 1)
             if not multi:
-                peek = next(iter(data), None)
-                multi = isinstance(getattr(peek, "features", None),
-                                   (list, tuple, dict))
-                if hasattr(data, "reset"):
-                    data.reset()
+                multi, data = self._peek_multi(data)
         if multi:
             carry, have, dropped_tail = self._run_multi_rounds(
                 data, epochs, global_batch, K, trainer, carry)
@@ -229,76 +252,70 @@ class SparkDl4jMultiLayer:
     def _run_multi_rounds(self, data, epochs, global_batch, K, trainer,
                           carry):
         """r5: MULTI-input/-output ComputationGraph local SGD (reference:
-        SparkComputationGraph trains MultiDataSet RDDs). The stream's
-        MultiDataSets are pooled per slot and re-cut into global batches;
-        each round ships dict x/y keyed by the graph's input/output names
-        through the same trainer (fit_round accepts pytrees). Masked
-        MultiDataSets are rejected with guidance — multi-output mask
-        routing lives in the fit path. Returns (carry, pending_batches,
-        dropped_rows)."""
+        SparkComputationGraph trains MultiDataSet RDDs). The stream runs
+        through _RebatchingMultiIterator (same pooling the K=1 path
+        uses); each round ships dict x/y keyed by the graph's
+        input/output names through the same trainer (fit_round accepts
+        pytrees), with the shared features mask and a single-array labels
+        mask riding along. Per-output labels-mask lists/dicts are
+        rejected by the rebatcher (that routing lives in the fit path).
+        Returns (carry, pending_batches, dropped_rows)."""
         import numpy as np
 
         conf = self.network.conf
         in_names = list(conf.network_inputs)
         out_names = list(conf.network_outputs)
-        pool_x = [[] for _ in in_names]
-        pool_y = [[] for _ in out_names]
-        pooled = 0
-        round_x, round_y, have = [], [], 0
 
-        def slots(arrs, names, what):
+        def named(arrs, names, what):
             if isinstance(arrs, dict):
-                return [np.asarray(arrs[n]) for n in names]
+                return {n: np.asarray(arrs[n]) for n in names}
             arrs = list(arrs)
             if len(arrs) != len(names):
                 raise ValueError(f"MultiDataSet carries {len(arrs)} {what} "
                                  f"arrays; the graph has {len(names)}")
-            return [np.asarray(a) for a in arrs]
+            return dict(zip(names, (np.asarray(a) for a in arrs)))
 
-        def pop_global_batch():
-            nonlocal pooled
-            cx = [np.concatenate(p) if len(p) > 1 else p[0] for p in pool_x]
-            cy = [np.concatenate(p) if len(p) > 1 else p[0] for p in pool_y]
-            for i, a in enumerate(cx):
-                pool_x[i] = [a[global_batch:]]
-            for i, a in enumerate(cy):
-                pool_y[i] = [a[global_batch:]]
-            pooled -= global_batch
-            return ([a[:global_batch] for a in cx],
-                    [a[:global_batch] for a in cy])
+        class _Epochs:
+            """Chain the source's epochs into ONE stream so the rebatcher
+            pools rows ACROSS epoch boundaries (a small dataset's partial
+            batches still complete rounds — the r4 accumulator-across-
+            epochs semantics)."""
 
-        for _ in range(epochs):
-            for ds in data:
-                if (getattr(ds, "features_mask", None) is not None
-                        or getattr(ds, "labels_mask", None) is not None):
-                    raise NotImplementedError(
-                        "masked MultiDataSets are not supported on the "
-                        "local-SGD path; fit the ComputationGraph "
-                        "directly (fit_batch routes per-output masks)")
-                fa = slots(ds.features, in_names, "feature")
-                la = slots(ds.labels, out_names, "label")
-                for i, a in enumerate(fa):
-                    pool_x[i].append(a)
-                for i, a in enumerate(la):
-                    pool_y[i].append(a)
-                pooled += fa[0].shape[0]
-                while pooled >= global_batch:
-                    gx, gy = pop_global_batch()
-                    round_x.append(gx)
-                    round_y.append(gy)
-                    have += 1
-                    if have == K:
-                        x_dict = {n: np.concatenate([r[i] for r in round_x])
-                                  for i, n in enumerate(in_names)}
-                        y_dict = {n: np.concatenate([r[i] for r in round_y])
-                                  for i, n in enumerate(out_names)}
-                        carry, loss = trainer.fit_round(carry, x_dict,
-                                                        y_dict)
-                        self.network.score_value = float(loss)
-                        round_x, round_y, have = [], [], 0
-            if hasattr(data, "reset"):
-                data.reset()
-        return carry, have, pooled
+            def __iter__(self):
+                for e in range(epochs):
+                    yield from data
+                    if hasattr(data, "reset") and e + 1 < epochs:
+                        data.reset()
+
+        round_x, round_y, round_m, round_lm, have = [], [], [], [], 0
+        # dp=global_batch: the K>1 round needs EXACT global batches (a
+        # truncated tail would mis-shard the whole round), so the
+        # rebatcher's tail flush is told to emit only full ones
+        rebatcher = _RebatchingMultiIterator(_Epochs(), global_batch,
+                                             dp=global_batch)
+        for mds in rebatcher:
+            round_x.append(named(mds.features, in_names, "feature"))
+            round_y.append(named(mds.labels, out_names, "label"))
+            if mds.features_mask is not None:
+                round_m.append(np.asarray(mds.features_mask))
+            if mds.labels_mask is not None:
+                round_lm.append(np.asarray(mds.labels_mask))
+            have += 1
+            if have == K:
+                x_dict = {n: np.concatenate([r[n] for r in round_x])
+                          for n in in_names}
+                y_dict = {n: np.concatenate([r[n] for r in round_y])
+                          for n in out_names}
+                carry, loss = trainer.fit_round(
+                    carry, x_dict, y_dict,
+                    mask=(np.concatenate(round_m) if round_m
+                          else None),
+                    label_mask=(np.concatenate(round_lm) if round_lm
+                                else None))
+                self.network.score_value = float(loss)
+                round_x, round_y, round_m, round_lm, have = \
+                    [], [], [], [], 0
+        return carry, have, getattr(rebatcher, "dropped_rows", 0)
 
     def _check_local_sgd_supported(self, K):
         """The K>1 path optimizes the model through its FUNCTIONAL loss
@@ -308,10 +325,11 @@ class SparkDl4jMultiLayer:
         (PerEntryUpdater: NoOp for frozen layers, per-layer overrides)
         and conf.max_grad_norm clipping, so transfer-learning and clipped
         configs train here too; multi-input/-output graphs ride dict
-        rounds (_run_multi_rounds). What remains rejected is center loss
-        (centers state and the center term live in the fit path) and
-        MASKED MultiDataSets (multi-output mask routing lives in the fit
-        path)."""
+        rounds (_run_multi_rounds), including shared-features-mask /
+        single-labels-mask MultiDataSets. What remains rejected is
+        center loss (centers state and the center term live in the fit
+        path) and PER-OUTPUT labels-mask lists/dicts (that routing lives
+        in the fit path)."""
         net = self.network
         conf = net.conf
         problems = []
@@ -417,6 +435,122 @@ class _RebatchingIterator:
         if tail:
             out, _, _, _, _ = _cat(tail)
             yield out
+
+
+class _RebatchingMultiIterator:
+    """MultiDataSet twin of _RebatchingIterator (r5): pools per-slot
+    feature/label arrays — plus the SHARED features mask and a
+    single-array labels mask — and re-cuts them into fixed global
+    batches; the tail flushes truncated to the largest dp multiple.
+    Per-output labels-mask lists/dicts are rejected (that routing lives
+    in the graph's fit path). Slot order/keys are preserved (list or
+    dict features both work, matching ComputationGraph._as_input_dict)."""
+
+    def __init__(self, source, batch_size: int, dp: int = 1):
+        self._source = source
+        self._batch = batch_size
+        self._dp = max(1, dp)
+
+    def reset(self):
+        if hasattr(self._source, "reset"):
+            self._source.reset()
+
+    @staticmethod
+    def _slots(arrs, keys=None):
+        """(keys_or_None, list_of_arrays). ``keys`` (from the stream's
+        first item) pins slot order for every later dict — items whose
+        dicts iterate in a different order must not silently swap slots —
+        and mismatched key sets fail loud."""
+        import numpy as np
+
+        if isinstance(arrs, dict):
+            if keys is None:
+                keys = list(arrs)
+            elif set(keys) != set(arrs):
+                raise ValueError(
+                    f"MultiDataSet slot keys changed mid-stream: "
+                    f"{sorted(arrs)} vs {sorted(keys)}")
+            return keys, [np.asarray(arrs[k]) for k in keys]
+        return None, [np.asarray(a) for a in
+                      (arrs if isinstance(arrs, (list, tuple)) else [arrs])]
+
+    def __iter__(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+
+        fkeys = lkeys = None
+        pool_f = pool_l = None
+        pool_m, pool_lm = [], []
+        any_mask = any_unmasked = any_lmask = any_no_lmask = False
+        have = 0
+
+        def _cut(n):
+            nonlocal have
+            cf = [np.concatenate(p) if len(p) > 1 else p[0] for p in pool_f]
+            cl = [np.concatenate(p) if len(p) > 1 else p[0] for p in pool_l]
+            cm = (np.concatenate(pool_m) if any_mask else None)
+            clm = (np.concatenate(pool_lm) if any_lmask else None)
+            for i, a in enumerate(cf):
+                pool_f[i] = [a[n:]]
+            for i, a in enumerate(cl):
+                pool_l[i] = [a[n:]]
+            pool_m[:] = [cm[n:]] if cm is not None else []
+            pool_lm[:] = [clm[n:]] if clm is not None else []
+            have -= n
+            feats = ([a[:n] for a in cf] if fkeys is None
+                     else dict(zip(fkeys, (a[:n] for a in cf))))
+            labels = ([a[:n] for a in cl] if lkeys is None
+                      else dict(zip(lkeys, (a[:n] for a in cl))))
+            return MultiDataSet(feats, labels,
+                                features_mask=None if cm is None
+                                else cm[:n],
+                                labels_mask=None if clm is None
+                                else clm[:n])
+
+        self.dropped_rows = 0
+        for ds in self._source:
+            lm = getattr(ds, "labels_mask", None)
+            if isinstance(lm, (list, tuple, dict)):
+                raise ValueError(
+                    "per-output labels masks (list/dict) are not supported "
+                    "on the spark re-batching path; use a single labels "
+                    "mask array or fit the ComputationGraph directly")
+            fm = getattr(ds, "features_mask", None)
+            fk, fa = self._slots(ds.features, fkeys)
+            lk, la = self._slots(ds.labels, lkeys)
+            if pool_f is None:
+                fkeys, lkeys = fk, lk
+                pool_f = [[] for _ in fa]
+                pool_l = [[] for _ in la]
+            for i, a in enumerate(fa):
+                pool_f[i].append(a)
+            for i, a in enumerate(la):
+                pool_l[i].append(a)
+            if fm is not None:
+                any_mask = True
+                pool_m.append(np.asarray(fm))
+            else:
+                any_unmasked = True
+            if lm is not None:
+                any_lmask = True
+                pool_lm.append(np.asarray(lm))
+            else:
+                any_no_lmask = True
+            if any_mask and any_unmasked:
+                raise ValueError(
+                    "mixed masked/unmasked MultiDataSets in one stream")
+            if any_lmask and any_no_lmask:
+                raise ValueError("mixed labels-masked/unmasked "
+                                 "MultiDataSets in one stream")
+            have += fa[0].shape[0]
+            while have >= self._batch:
+                yield _cut(self._batch)
+        if pool_f is not None:
+            tail = (have // self._dp) * self._dp
+            if tail:
+                yield _cut(tail)
+            self.dropped_rows = have   # rows below the dp multiple
 
 
 class SparkComputationGraph(SparkDl4jMultiLayer):

@@ -1276,7 +1276,165 @@ class TestSparkLocalSgdRouting:
         l1 = float(net.score((x, y)))
         assert np.isfinite(l1) and l1 < l0, (l0, l1)
 
-    def test_masked_multidataset_rejected_on_local_sgd(self, rng):
+    def test_k1_sync_path_with_multidataset_stream(self, rng):
+        """averaging_frequency=1 (sync SPMD) fed a MultiDataSet stream:
+        the slot-aware rebatcher must route it — the DataSet rebatcher
+        mis-sharded list features into a stacked mess (r5 bug, fixed)."""
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(lr=0.1)).graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(**{"a": InputType.feed_forward(3),
+                                    "b": InputType.feed_forward(5)})
+                .add_layer("fa", DenseLayer(n_out=8, activation="relu"), "a")
+                .add_layer("fb", DenseLayer(n_out=8, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "fa", "fb")
+                .add_layer("o", OutputLayer(n_out=2, activation="softmax",
+                                            loss="mcxent"), "m")
+                .set_outputs("o")
+                .build())
+        a = rng.normal(size=(128, 3)).astype(np.float32)
+        b = rng.normal(size=(128, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            (a[:, 0] + b[:, 0] > 0).astype(np.int64)]
+
+        class _Stream:
+            def __iter__(self):
+                return iter(MultiDataSet([a, b], [y]).batches(64))
+
+            def reset(self):
+                pass
+
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(1).build())
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                    ComputationGraph(conf).init(), tm)
+        net = spark.network
+        l0 = float(net.score(MultiDataSet([a, b], [y])))
+        spark.fit(_Stream(), epochs=8)
+        l1 = float(net.score(MultiDataSet([a, b], [y])))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+    def test_multi_rebatcher_pins_dict_slot_order_and_counts_drops(
+            self, rng):
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.parallel.spark import \
+            _RebatchingMultiIterator
+
+        a1 = np.full((3, 2), 1.0, np.float32)
+        b1 = np.full((3, 2), 10.0, np.float32)
+        a2 = np.full((3, 2), 2.0, np.float32)
+        b2 = np.full((3, 2), 20.0, np.float32)
+        y = np.zeros((3, 1), np.float32)
+
+        # second item's dict iterates in the REVERSE key order — slots
+        # must still pool by key, not by position
+        stream = [MultiDataSet({"a": a1, "b": b1}, [y]),
+                  MultiDataSet({"b": b2, "a": a2}, [y])]
+        out = list(_RebatchingMultiIterator(stream, 4, dp=2))
+        got_a = np.concatenate([np.asarray(o.features["a"]) for o in out])
+        got_b = np.concatenate([np.asarray(o.features["b"]) for o in out])
+        assert (got_a < 5).all(), got_a       # only 1.0/2.0 values
+        assert (got_b >= 10).all(), got_b     # only 10/20 values
+        # mismatched key sets fail loud
+        bad = [MultiDataSet({"a": a1, "b": b1}, [y]),
+               MultiDataSet({"a": a2, "c": b2}, [y])]
+        with pytest.raises(ValueError, match="slot keys changed"):
+            list(_RebatchingMultiIterator(bad, 4, dp=2))
+
+    def test_multi_local_sgd_pools_across_epochs_and_warns(self, rng):
+        """60-row stream with global_batch=64: single epochs drop
+        everything, but rounds must complete by pooling rows ACROSS
+        epochs (the r4 accumulator semantics) and leftovers must warn."""
+        import warnings as _w
+
+        from deeplearning4j_tpu.datasets import MultiDataSet
+        from deeplearning4j_tpu.nn.conf.graph import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(lr=0.1)).graph_builder()
+                .add_inputs("a", "b")
+                .set_input_types(**{"a": InputType.feed_forward(3),
+                                    "b": InputType.feed_forward(5)})
+                .add_layer("fa", DenseLayer(n_out=8, activation="relu"), "a")
+                .add_layer("fb", DenseLayer(n_out=8, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "fa", "fb")
+                .add_layer("o", OutputLayer(n_out=2, activation="softmax",
+                                            loss="mcxent"), "m")
+                .set_outputs("o")
+                .build())
+        a = rng.normal(size=(60, 3)).astype(np.float32)
+        b = rng.normal(size=(60, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 60)]
+
+        class _Stream:
+            def __iter__(self):
+                return iter(MultiDataSet([a, b], [y]).batches(60))
+
+            def reset(self):
+                pass
+
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(2).build())
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                    ComputationGraph(conf).init(), tm)
+        net = spark.network
+        p0 = jax.tree_util.tree_map(np.asarray, net.params)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            # 4 epochs x 60 rows = 240 rows = 3 global batches of 64 ->
+            # one full K=2 round runs (params move), 1 pending batch +
+            # 48 leftover rows -> warning
+            spark.fit(_Stream(), epochs=4)
+        moved = any(
+            bool(np.any(np.asarray(x1) != np.asarray(x0)))
+            for x0, x1 in zip(jax.tree_util.tree_leaves(p0),
+                              jax.tree_util.tree_leaves(net.params)))
+        assert moved, "rounds never completed despite cross-epoch pooling"
+        assert any("dropped" in str(r.message) for r in rec)
+
+    def test_one_shot_generator_keeps_first_batch_at_k1(self, rng):
+        """The multi-stream peek must not consume a one-shot generator's
+        first (and only) DataSet on the K=1 path."""
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.parallel.spark import (
+            ParameterAveragingTrainingMaster, SparkDl4jMultiLayer)
+
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .updater(Sgd(lr=0.1)).graph_builder()
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.feed_forward(8)})
+                .add_layer("d", DenseLayer(n_out=8, activation="relu"),
+                           "in")
+                .add_layer("o", OutputLayer(n_out=4, activation="softmax",
+                                            loss="mcxent"), "d")
+                .set_outputs("o")
+                .build())
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+        tm = (ParameterAveragingTrainingMaster.Builder()
+              .batch_size_per_worker(8).averaging_frequency(1).build())
+        spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                    ComputationGraph(conf).init(), tm)
+        net = spark.network
+        p0 = jax.tree_util.tree_map(np.asarray, net.params)
+        spark.fit(iter([DataSet(x, y)]), epochs=1)   # one-shot generator
+        moved = any(
+            bool(np.any(np.asarray(x1) != np.asarray(x0)))
+            for x0, x1 in zip(jax.tree_util.tree_leaves(p0),
+                              jax.tree_util.tree_leaves(net.params)))
+        assert moved, "the peek swallowed the only batch"
+
+    def test_masked_multidataset_trains_on_local_sgd(self, rng):
         from deeplearning4j_tpu.datasets import MultiDataSet
         from deeplearning4j_tpu.nn.conf.graph import MergeVertex
         from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -1319,8 +1477,27 @@ class TestSparkLocalSgdRouting:
               .batch_size_per_worker(4).averaging_frequency(4).build())
         spark = SparkDl4jMultiLayer(DeviceMesh(data=8),
                                     ComputationGraph(conf).init(), tm)
-        with pytest.raises(NotImplementedError, match="masked MultiDataSet"):
-            spark.fit(_Stream(), epochs=1)
+        net = spark.network
+        mds_all = MultiDataSet([s, s], [y, y], features_mask=m)
+        l0 = float(net.score(mds_all))
+        spark.fit(_Stream(), epochs=8)   # r5: shared-mask multi TRAINS
+        l1 = float(net.score(mds_all))
+        assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+        # per-output labels-mask lists stay rejected with guidance
+        class _BadStream:
+            def __iter__(self):
+                return iter(MultiDataSet(
+                    [s, s], [y, y],
+                    labels_mask=[m, m]).batches(32))
+
+            def reset(self):
+                pass
+
+        spark2 = SparkDl4jMultiLayer(DeviceMesh(data=8),
+                                     ComputationGraph(conf).init(), tm)
+        with pytest.raises(ValueError, match="per-output labels masks"):
+            spark2.fit(_BadStream(), epochs=1)
 
     def test_unsupported_configs_rejected_loudly(self, rng):
         """What the round plumbing genuinely cannot express (center loss)
