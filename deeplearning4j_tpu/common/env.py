@@ -33,6 +33,10 @@ class Environment:
     VERBOSE = "DL4J_TPU_VERBOSE"
     # Per-op timing profiler (org.nd4j.linalg.profiler.OpProfiler analog).
     PROFILING = "DL4J_TPU_PROFILING"
+    # Unified monitoring layer (metrics registry + fit-loop instrumentation,
+    # deeplearning4j_tpu/monitoring). Default OFF: the fit hot path then
+    # performs no registry/tracer calls (tests enforce zero overhead).
+    MONITORING = "DL4J_TPU_MONITORING"
     # Force the fused LSTM to take the scan-recompute backward instead of
     # the Pallas backward kernel (A/B measurement + escape hatch).
     LSTM_SCAN_BWD = "DL4J_TPU_LSTM_SCAN_BWD"
@@ -48,6 +52,7 @@ class Environment:
         self.nan_panic = _flag(self.NAN_PANIC)
         self.verbose = _flag(self.VERBOSE)
         self.profiling = _flag(self.PROFILING)
+        self.monitoring = _flag(self.MONITORING)
         self.lstm_scan_bwd = _flag(self.LSTM_SCAN_BWD)
         self.gru_scan_bwd = _flag(self.GRU_SCAN_BWD)
 

@@ -1,0 +1,264 @@
+"""Unified monitoring layer: metrics registry + host-side span tracing.
+
+Reference analog (SURVEY.md §5 "Metrics/observability"): the reference's
+StatsListener/StatsStorage/UIServer push pipeline plus PerformanceListener's
+memory/GC reporting. Here the two production-grade halves it lacked:
+
+- a process-wide **MetricsRegistry** (Counter / Gauge / Histogram, labeled,
+  thread-safe) with Prometheus text exposition, scraped from ``GET
+  /metrics`` on both the UI server and the serving server;
+- a host-side **SpanTracer** (``span("name")`` context manager, nestable,
+  thread-aware) emitting Chrome trace-event JSON for Perfetto — the HOST
+  timeline complementing ``profiler.trace()``'s device timeline.
+
+Instrumented subsystems (fit loops, local-SGD rounds, serving, checkpoints)
+fetch their instrument bundle through the ``*_monitor()`` accessors below,
+which return ``None`` while monitoring is disabled — the callers' contract
+is to skip ALL instrumentation on ``None``, so the default-off hot path
+performs exactly one boolean check and no registry/tracer calls (enforced
+by tests/test_monitoring.py's zero-overhead guard).
+
+Enablement: the ``DL4J_TPU_MONITORING`` env flag (default off, read at
+import) or ``monitoring.enable()`` / ``disable()`` at runtime. Tracing is a
+separate, additive switch: ``start_tracing()`` installs the global tracer
+(spans are recorded only while one is installed), ``stop_tracing(path)``
+detaches it and optionally writes the trace JSON.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.common.env import env
+from deeplearning4j_tpu.monitoring.registry import (
+    DEFAULT_BUCKETS, SIZE_BUCKETS, Counter, Gauge, Histogram, MetricFamily,
+    MetricsRegistry,
+)
+from deeplearning4j_tpu.monitoring.tracing import SpanTracer, validate_nesting
+
+_REGISTRY = MetricsRegistry()
+_enabled: bool = env.monitoring
+_tracer: Optional[SpanTracer] = None
+_fit_mon = None
+_serving_mon = None
+_localsgd_mon = None
+_ckpt_mon = None
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry every scrape endpoint reads."""
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Fresh registry + tracer detached + enablement back to the env flag.
+    Test isolation hook; instrument bundles are re-created lazily against
+    the new registry."""
+    global _REGISTRY, _tracer, _enabled
+    global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon
+    _REGISTRY = MetricsRegistry()
+    _tracer = None
+    _enabled = env.monitoring
+    _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
+
+
+def metrics_text() -> str:
+    """The Prometheus exposition body for GET /metrics."""
+    return _REGISTRY.exposition()
+
+
+# ---- tracing ------------------------------------------------------------
+def start_tracing() -> SpanTracer:
+    """Install (and return) the global span tracer."""
+    global _tracer
+    _tracer = SpanTracer()
+    return _tracer
+
+
+def stop_tracing(path: Optional[str] = None) -> Optional[SpanTracer]:
+    """Detach the global tracer; with ``path``, save its Chrome trace
+    JSON there first. Returns the detached tracer (None if none active)."""
+    global _tracer
+    t, _tracer = _tracer, None
+    if t is not None and path is not None:
+        t.save(path)
+    return t
+
+
+def tracer() -> Optional[SpanTracer]:
+    return _tracer
+
+
+@contextlib.contextmanager
+def span(name: str, **args):
+    """A span on the global tracer; transparent no-op when tracing is
+    inactive. For per-iteration hot paths prefer the ``*_monitor()``
+    bundles (None-gated), which skip even this check."""
+    t = _tracer
+    if t is None:
+        yield None
+    else:
+        with t.span(name, **args):
+            yield t
+
+
+# ---- per-subsystem instrument bundles -----------------------------------
+class _FitMonitor:
+    """Fit-loop instruments: the per-iteration wall-time split (data wait /
+    device step / listeners) as histograms + spans, plus iteration counter
+    and score gauge."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.iterations = reg.counter(
+            "dl4j_train_iterations_total", "Completed training iterations")
+        self.score = reg.gauge(
+            "dl4j_train_score", "Training loss/score of the latest iteration")
+        self._hists = {
+            "data_wait": reg.histogram(
+                "dl4j_train_data_wait_seconds",
+                "Per-iteration time fit() waits on the data iterator"),
+            "device_step": reg.histogram(
+                "dl4j_train_device_step_seconds",
+                "Host-observed jitted train-step time incl. device sync"),
+            "listeners": reg.histogram(
+                "dl4j_train_listener_seconds",
+                "Per-iteration time in host-side listener callbacks"),
+        }
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time one fit phase into its histogram (and the tracer, when a
+        trace is active)."""
+        t = _tracer
+        cm = t.span("fit." + name) if t is not None else None
+        if cm is not None:
+            cm.__enter__()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._hists[name].observe(time.perf_counter() - t0)
+            if cm is not None:
+                cm.__exit__(None, None, None)
+
+    def iteration_done(self, score: float) -> None:
+        self.iterations.inc()
+        self.score.set(float(score))
+
+    def wrap_batches(self, data):
+        """Iterate ``data`` timing each pull as the data-wait phase."""
+        it = iter(data)
+        while True:
+            with self.phase("data_wait"):
+                try:
+                    ds = next(it)
+                except StopIteration:
+                    return
+            yield ds
+
+
+class _ServingMonitor:
+    """Serving-tier instruments: request latency by route/status, in-flight
+    and queue-depth gauges, device batch-size distribution."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.request_seconds = reg.histogram(
+            "dl4j_serving_request_seconds",
+            "HTTP request handling latency", labels=("route", "code"))
+        self.in_flight = reg.gauge(
+            "dl4j_serving_in_flight", "Requests currently being handled")
+        self.batch_size = reg.histogram(
+            "dl4j_serving_batch_size",
+            "Coalesced inference batch sizes", buckets=SIZE_BUCKETS)
+        self.queue_depth = reg.gauge(
+            "dl4j_serving_queue_depth",
+            "Pending requests in the batching queue at dispatch")
+
+
+class _LocalSgdMonitor:
+    """Local-SGD round instruments: sync (round) duration, rounds counter,
+    rows dropped by rebatching/round boundaries."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.sync_seconds = reg.histogram(
+            "dl4j_localsgd_sync_seconds",
+            "Wall time of one averaging round (K local steps + pmean sync)")
+        self.rounds = reg.counter(
+            "dl4j_localsgd_rounds_total", "Completed averaging rounds")
+        self.dropped_rows = reg.counter(
+            "dl4j_localsgd_dropped_rows_total",
+            "Sample rows dropped by global-batch/round boundaries")
+
+
+class _CheckpointMonitor:
+    """Checkpoint instruments: save submit duration + payload bytes."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.save_seconds = reg.histogram(
+            "dl4j_checkpoint_save_seconds",
+            "Checkpoint save() duration (submit time under async saves)")
+        self.saved_bytes = reg.counter(
+            "dl4j_checkpoint_bytes_total",
+            "Total bytes of checkpoint payloads saved")
+        self.saves = reg.counter(
+            "dl4j_checkpoint_saves_total", "Checkpoint saves issued")
+
+
+def _bundle(cache_name: str, cls):
+    if not _enabled:
+        return None
+    mon = globals()[cache_name]
+    if mon is None or mon.reg is not _REGISTRY:
+        mon = cls(_REGISTRY)
+        globals()[cache_name] = mon
+    return mon
+
+
+def fit_monitor() -> Optional[_FitMonitor]:
+    """Fit-loop bundle, or None when monitoring is off (callers skip all
+    instrumentation on None — the zero-overhead contract)."""
+    return _bundle("_fit_mon", _FitMonitor)
+
+
+def serving_monitor() -> Optional[_ServingMonitor]:
+    return _bundle("_serving_mon", _ServingMonitor)
+
+
+def localsgd_monitor() -> Optional[_LocalSgdMonitor]:
+    return _bundle("_localsgd_mon", _LocalSgdMonitor)
+
+
+def checkpoint_monitor() -> Optional[_CheckpointMonitor]:
+    return _bundle("_ckpt_mon", _CheckpointMonitor)
+
+
+from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
+    "SpanTracer", "MetricsListener", "DEFAULT_BUCKETS", "SIZE_BUCKETS",
+    "registry", "enabled", "enable", "disable", "reset", "metrics_text",
+    "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
+    "fit_monitor", "serving_monitor", "localsgd_monitor",
+    "checkpoint_monitor",
+]

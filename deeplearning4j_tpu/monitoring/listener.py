@@ -1,0 +1,76 @@
+"""MetricsListener — bridges the listener bus into the metrics registry.
+
+Reference analog: StatsListener + PerformanceListener, re-targeted: instead
+of pushing records into a StatsStorage, the same per-iteration observations
+(score, iteration wall time, host RSS / device memory) land in the metrics
+registry, so the UI, a Prometheus scrape of ``/metrics``, and bench readouts
+all read one source of truth.
+
+Attaching this listener is itself the opt-in: it records regardless of the
+``DL4J_TPU_MONITORING`` flag (that flag gates only the implicit fit-loop
+hooks). It deliberately does NOT touch ``dl4j_train_iterations_total`` /
+``dl4j_train_device_step_seconds`` — those belong to the fit-loop monitor,
+and double-counting when both are active would corrupt rates.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import deeplearning4j_tpu.monitoring as monitoring
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+class MetricsListener(TrainingListener):
+    """Score / throughput / system metrics into a MetricsRegistry.
+
+    ``sysmetrics_every``: sample host RSS + device memory every N
+    iterations (they cost a /proc read + a PJRT stats call).
+    """
+
+    def __init__(self, registry=None, sysmetrics_every: int = 10):
+        self._registry = registry
+        self.sysmetrics_every = max(1, sysmetrics_every)
+        self._last_time: Optional[float] = None
+        self._inst = None
+
+    def _instruments(self):
+        reg = self._registry or monitoring.registry()
+        if self._inst is None or self._inst["reg"] is not reg:
+            self._inst = {
+                "reg": reg,
+                "score": reg.gauge(
+                    "dl4j_train_score",
+                    "Training loss/score of the latest iteration"),
+                "iter_seconds": reg.histogram(
+                    "dl4j_train_iteration_seconds",
+                    "Wall time between successive iteration_done callbacks"),
+                "epochs": reg.counter(
+                    "dl4j_train_epochs_total", "Completed training epochs"),
+                "rss": reg.gauge(
+                    "dl4j_host_rss_mb", "Host resident set size (MiB)"),
+                "dev_mem": reg.gauge(
+                    "dl4j_device_mem_in_use_mb",
+                    "PJRT device memory in use (MiB), when exposed"),
+            }
+        return self._inst
+
+    def iteration_done(self, model, iteration: int, epoch: int, score: float):
+        inst = self._instruments()
+        inst["score"].set(float(score))
+        now = time.perf_counter()
+        if self._last_time is not None:
+            inst["iter_seconds"].observe(now - self._last_time)
+        self._last_time = now
+        if iteration % self.sysmetrics_every == 0:
+            from deeplearning4j_tpu.common.sysmetrics import system_metrics
+
+            sm = system_metrics()
+            inst["rss"].set(sm.get("host_rss_mb", 0.0))
+            if "device_mem_in_use_mb" in sm:
+                inst["dev_mem"].set(sm["device_mem_in_use_mb"])
+
+    def on_epoch_end(self, model, epoch: int):
+        self._instruments()["epochs"].inc()
+        self._last_time = None  # epoch boundary: don't count eval/reset gaps

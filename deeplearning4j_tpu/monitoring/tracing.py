@@ -1,0 +1,123 @@
+"""Host-side span tracer emitting Chrome trace-event JSON.
+
+Complements profiler.trace() (the jax.profiler DEVICE timeline) with the
+HOST timeline the reference never had: where a training step's wall time
+goes between data wait, the jitted device step, and listener callbacks.
+Spans are nestable context managers and thread-aware (each span records the
+emitting thread's id), so serving worker threads and the fit loop interleave
+correctly on separate tracks.
+
+The output is the Chrome trace-event format — begin/end ("B"/"E") event
+pairs under ``{"traceEvents": [...]}`` — which Perfetto
+(https://ui.perfetto.dev) and chrome://tracing load directly. Timestamps
+are microseconds from tracer start (``perf_counter`` based, so spans are
+comparable across threads of this process).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _json_safe(v):
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+class SpanTracer:
+    """Collects nested, thread-aware spans as Chrome trace events.
+
+    Usage::
+
+        tracer = SpanTracer()
+        with tracer.span("fit.iteration", step=3):
+            with tracer.span("fit.device_step"):
+                ...
+        tracer.save("trace.json")   # open in Perfetto
+    """
+
+    def __init__(self, process_name: str = "deeplearning4j_tpu") -> None:
+        self._lock = threading.Lock()
+        self._events: List[Dict] = []
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._events.append({
+            "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
+            "args": {"name": process_name}})
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args):
+        """Time a section as a begin/end event pair on this thread."""
+        tid = threading.get_ident()
+        begin: Dict = {"name": name, "ph": "B", "ts": self._now_us(),
+                       "pid": self._pid, "tid": tid}
+        if args:
+            begin["args"] = {k: _json_safe(v) for k, v in args.items()}
+        with self._lock:
+            self._events.append(begin)
+        try:
+            yield self
+        finally:
+            end = {"name": name, "ph": "E", "ts": self._now_us(),
+                   "pid": self._pid, "tid": tid}
+            with self._lock:
+                self._events.append(end)
+
+    def instant(self, name: str, **args) -> None:
+        """A zero-duration marker event (thread-scoped)."""
+        ev: Dict = {"name": name, "ph": "i", "s": "t",
+                    "ts": self._now_us(), "pid": self._pid,
+                    "tid": threading.get_ident()}
+        if args:
+            ev["args"] = {k: _json_safe(v) for k, v in args.items()}
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def to_dict(self) -> Dict:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        """Write the Perfetto/chrome://tracing-loadable JSON file."""
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f)
+        return str(path)
+
+
+def validate_nesting(events: List[Dict]) -> None:
+    """Raise ValueError unless every thread's B/E events form balanced,
+    properly nested pairs (the invariant trace viewers rely on). Used by
+    tests; cheap enough to run on any saved trace."""
+    stacks: Dict[int, List[str]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        stack = stacks.setdefault(ev["tid"], [])
+        if ph == "B":
+            stack.append(ev["name"])
+        else:
+            if not stack or stack[-1] != ev["name"]:
+                raise ValueError(
+                    f"unbalanced trace: E {ev['name']!r} closes "
+                    f"{stack[-1] if stack else None!r} on tid {ev['tid']}")
+            stack.pop()
+    leftover = {tid: s for tid, s in stacks.items() if s}
+    if leftover:
+        raise ValueError(f"unclosed spans at end of trace: {leftover}")

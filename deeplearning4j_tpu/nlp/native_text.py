@@ -19,8 +19,9 @@ Word2Vec._ascii_sample) — ``native_front=True`` forces byte-level
 semantics on any corpus. Caveat for forced non-UTF-8 corpora:
 native_word_counts decodes words with errors="replace", so byte sequences
 that are invalid UTF-8 can collapse onto replacement-character vocab keys
-that the raw byte stream then never matches (such words count toward the
-vocabulary but produce no training pairs).
+that the raw byte stream then never matches (collided counts SUM onto the
+shared key; such words count toward the vocabulary but produce no
+training pairs).
 """
 
 from __future__ import annotations
@@ -52,7 +53,10 @@ def native_word_counts(path: str, n_threads: int = 4) -> Optional[Dict[str, int]
         counts: Dict[str, int] = {}
         for line in buf.value.decode("utf-8", errors="replace").splitlines():
             word, _, n = line.rpartition(" ")
-            counts[word] = int(n)
+            # errors="replace" can collapse distinct invalid-UTF-8 byte
+            # sequences onto one replacement-character key: sum, don't
+            # overwrite (ADVICE r5)
+            counts[word] = counts.get(word, 0) + int(n)
         return counts
     finally:
         lib.dl4j_wc_destroy(h)

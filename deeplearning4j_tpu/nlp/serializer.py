@@ -91,9 +91,25 @@ def read_word_vectors(path: str,
         if len(parts) == 2 and all(p.isdigit() for p in parts):
             V, D = int(parts[0]), int(parts[1])   # "V D" header
         else:                           # headerless: first line is data
-            words.append(parts[0])
-            rows.append(np.asarray([float(v) for v in parts[1:]], np.float32))
-            D = len(parts) - 1
+            # infer D from the trailing float-parseable fields — a first
+            # WORD containing spaces ("new york 0.1 ...") must not inflate
+            # D and mis-split every later row (ADVICE r5). At least one
+            # leading field is always the word, so the scan stops there;
+            # an all-numeric line keeps the old single-token-word reading.
+            D = 0
+            for p in reversed(parts[1:]):
+                try:
+                    float(p)
+                except ValueError:
+                    break
+                D += 1
+            if D == 0:
+                raise ValueError(
+                    f"{path}:1: headerless first line has no trailing "
+                    f"float fields to infer the vector dimension from")
+            words.append(" ".join(parts[:-D]))
+            rows.append(np.asarray([float(v) for v in parts[-D:]],
+                                   np.float32))
         for lineno, line in enumerate(f, consumed + 1):
             parts = line.split()        # any whitespace separates fields
             if not parts:

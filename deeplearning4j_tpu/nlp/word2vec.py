@@ -465,7 +465,19 @@ class Word2Vec:
 
     def fit(self, corpus, chunk_sentences: int = 4096,
             native_front: Optional[bool] = None) -> "Word2Vec":
-        """Two streaming passes per epoch over ``corpus`` (r4): pass 1
+        """Fit on a sentence corpus.
+
+        **Determinism note:** even with a fixed ``seed``, eligible runs
+        (file-backed ASCII LineSentenceIterator corpus, skip-gram config,
+        default tokenizer, loadable native lib) AUTO-ROUTE to the native
+        concurrent front, whose multi-threaded batch arrival order is
+        NONDETERMINISTIC run-to-run — exactly like the reference's Hogwild
+        workers, the same seed no longer reproduces embeddings
+        bit-for-bit. Pass ``native_front=False`` to force the
+        deterministic (seed-reproducible) Python stream, or ``True`` to
+        require the concurrent native path.
+
+        Two streaming passes per epoch over ``corpus`` (r4): pass 1
         builds the vocabulary sentence-by-sentence; each epoch then streams
         sentences again, encoding + subsampling on the fly and training in
         chunks of ``chunk_sentences`` — the corpus itself is never

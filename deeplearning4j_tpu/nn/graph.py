@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
@@ -465,13 +466,28 @@ class ComputationGraph:
         # vertices consume masks as a LIST (one shared [B, T] sequence
         # mask threaded to every vertex; LayerVertex reads masks[0]) — a
         # bare array would hit `if masks` truthiness inside the trace
-        self.params, self.state, self.opt_state, loss = fn(
-            self.params, self.state, self.opt_state,
-            jnp.asarray(self.step_count, jnp.int32), inputs, labels, self._next_key(),
-            None if mask is None else [jnp.asarray(mask)], labels_masks)
-        self.score_value = float(loss)
-        for lst in self.listeners:
-            lst.iteration_done(self, self.step_count, self.epoch_count, self.score_value)
+        args = (self.params, self.state, self.opt_state,
+                jnp.asarray(self.step_count, jnp.int32), inputs, labels,
+                self._next_key(),
+                None if mask is None else [jnp.asarray(mask)], labels_masks)
+        mon = monitoring.fit_monitor()
+        if mon is None:
+            # hot path: monitoring off means NO registry/tracer calls here
+            self.params, self.state, self.opt_state, loss = fn(*args)
+            self.score_value = float(loss)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.step_count, self.epoch_count,
+                                   self.score_value)
+        else:
+            with mon.phase("device_step"):
+                self.params, self.state, self.opt_state, loss = fn(*args)
+                # the host fetch is the device sync: step time includes it
+                self.score_value = float(loss)
+            with mon.phase("listeners"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.step_count,
+                                       self.epoch_count, self.score_value)
+            mon.iteration_done(self.score_value)
         self.step_count += 1
         return self.score_value
 
@@ -483,7 +499,10 @@ class ComputationGraph:
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
-            for ds in data:
+            # data-wait spans time the iterator pull per batch (host input
+            # pipeline vs device step split); None = monitoring off
+            mon = monitoring.fit_monitor()
+            for ds in (data if mon is None else mon.wrap_batches(data)):
                 self.fit_batch(ds)
             if hasattr(data, "reset"):
                 data.reset()

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
@@ -406,15 +407,29 @@ class MultiLayerNetwork:
             step_fn = self._make_train_step()
             self._jit_cache["train"] = step_fn
         key = self._next_key()
-        self.params, self.state, self.opt_state, loss = step_fn(
-            self.params, self.state, self.opt_state,
-            jnp.asarray(self.step_count, jnp.int32), jnp.asarray(x), jnp.asarray(y), key,
-            None if mask is None else jnp.asarray(mask),
-            None if label_mask is None else jnp.asarray(label_mask),
-        )
-        self.score_value = float(loss)
-        for lst in self.listeners:
-            lst.iteration_done(self, self.step_count, self.epoch_count, self.score_value)
+        args = (self.params, self.state, self.opt_state,
+                jnp.asarray(self.step_count, jnp.int32), jnp.asarray(x),
+                jnp.asarray(y), key,
+                None if mask is None else jnp.asarray(mask),
+                None if label_mask is None else jnp.asarray(label_mask))
+        mon = monitoring.fit_monitor()
+        if mon is None:
+            # hot path: monitoring off means NO registry/tracer calls here
+            self.params, self.state, self.opt_state, loss = step_fn(*args)
+            self.score_value = float(loss)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.step_count, self.epoch_count,
+                                   self.score_value)
+        else:
+            with mon.phase("device_step"):
+                self.params, self.state, self.opt_state, loss = step_fn(*args)
+                # the host fetch is the device sync: step time includes it
+                self.score_value = float(loss)
+            with mon.phase("listeners"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.step_count,
+                                       self.epoch_count, self.score_value)
+            mon.iteration_done(self.score_value)
         self.step_count += 1
         return self.score_value
 
@@ -427,7 +442,10 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
-            for ds in data:
+            # data-wait spans time the iterator pull per batch (host input
+            # pipeline vs device step split); None = monitoring off
+            mon = monitoring.fit_monitor()
+            for ds in (data if mon is None else mon.wrap_batches(data)):
                 self.fit_batch(ds)
             if hasattr(data, "reset"):
                 data.reset()

@@ -41,7 +41,10 @@ def _sds(shape, dtype, vma=None):
     """ShapeDtypeStruct with varying-mesh-axes annotation when running under
     shard_map (ring attention) with VMA checking on."""
     if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        except TypeError:  # pragma: no cover — pre-0.7 jax tracks no VMA
+            pass           # (shard_map runs check_rep there; see _compat)
     return jax.ShapeDtypeStruct(shape, dtype)
 
 

@@ -2,17 +2,34 @@
 
 from __future__ import annotations
 
+import inspect
+
 from jax import lax
 
 try:  # jax >= 0.6 moved shard_map to jax.shard_map
-    from jax import shard_map
+    from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 if hasattr(lax, "pcast"):  # jax >= 0.9; pvary is deprecated
     def pvary(x, axes):
         return lax.pcast(x, axes, to="varying")
-else:  # pragma: no cover
+elif hasattr(lax, "pvary"):
     pvary = lax.pvary
+else:  # pragma: no cover — jax < 0.7: no varying-manual-axes tracking at
+    # all (shard_map's check_rep treats body-created constants as
+    # replicated until proven otherwise), so the annotation is a no-op
+    def pvary(x, axes):
+        return x
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:  # pragma: no cover — the kwarg was named check_rep before jax 0.7
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
 
 __all__ = ["shard_map", "pvary"]

@@ -16,6 +16,7 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
 
@@ -80,6 +81,11 @@ class ParallelInference:
                     batch.append(self._q.get(timeout=self.queue_timeout_s))
                 except queue.Empty:
                     break
+            mon = monitoring.serving_monitor()
+            if mon is not None:
+                # batch-size distribution + queue backlog at dispatch time
+                mon.batch_size.observe(len(batch))
+                mon.queue_depth.set(self._q.qsize())
             xs = np.stack([b[0] for b in batch])
             n = xs.shape[0]
             if self.pad_batches and n > 1:

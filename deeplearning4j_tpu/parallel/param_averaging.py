@@ -20,6 +20,7 @@ same fused train step the single-device path runs.
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -27,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.parallel._compat import shard_map
 
 
@@ -268,7 +270,18 @@ class ParameterAveragingTrainer:
         if self._round is None or self._round_keys != keys:
             self._round = self._build(carry, keys)
             self._round_keys = keys
-        return self._round(carry, batch)
+        mon = monitoring.localsgd_monitor()
+        if mon is None:
+            return self._round(carry, batch)
+        # sync duration = wall time of the whole round (K local steps +
+        # the pmean sync), blocked on the loss so the device work is in it
+        with monitoring.span("localsgd.round", k=K, dp=dp):
+            t0 = time.perf_counter()
+            carry, loss = self._round(carry, batch)
+            jax.block_until_ready(loss)
+            mon.sync_seconds.observe(time.perf_counter() - t0)
+        mon.rounds.inc()
+        return carry, loss
 
     def params(self, carry):
         """The (replica-identical) averaged params as a plain tree."""

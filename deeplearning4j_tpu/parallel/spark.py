@@ -227,11 +227,23 @@ class SparkDl4jMultiLayer:
                 if hasattr(data, "reset"):
                     data.reset()
         if have or dropped_tail:
+            # one unit on both the single and multi paths: ROWS. `have`
+            # counts pooled global batches stranded in an incomplete
+            # round; `dropped_tail` already counts rows that never filled
+            # a global batch (ADVICE r5 — the old message mixed units)
+            dropped_rows = have * global_batch + dropped_tail
+            from deeplearning4j_tpu import monitoring
+
+            mon = monitoring.localsgd_monitor()
+            if mon is not None:
+                mon.dropped_rows.inc(dropped_rows)
             warnings.warn(
-                f"local-SGD fit dropped {have} trailing batch(es) that did "
-                f"not fill an averaging round of {K} and {dropped_tail} "
-                f"tail example(s) that did not fill a global batch; size "
-                f"the dataset/epochs accordingly for full coverage")
+                f"local-SGD fit dropped {dropped_rows} sample row(s): "
+                f"{have} pooled global batch(es) ({have * global_batch} "
+                f"rows) stranded short of an averaging round of {K}, plus "
+                f"{dropped_tail} tail row(s) that did not fill a global "
+                f"batch; size the dataset/epochs accordingly for full "
+                f"coverage")
         # averaged parameters AND network state (BN running stats, r4)
         # flow back into the model (the reference's post-fit network
         # state: the master serializes PARAMS; updater moments restart

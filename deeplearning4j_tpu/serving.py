@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
+from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 
 
@@ -38,6 +40,9 @@ class _HttpServerMixin:
 def _serve_json(host, port, post_routes, get_routes):
     """Shared JSON-over-HTTP scaffolding for the serving endpoints: routes
     are {path: fn(body-dict) -> payload-dict}; errors become JSON 400s.
+    Every server also answers ``GET /metrics`` with the process-wide
+    Prometheus exposition (text format), and — when monitoring is enabled —
+    records per-route request latency and an in-flight gauge.
     Returns (httpd, thread) — call httpd.shutdown()/server_close() to stop.
     """
 
@@ -56,10 +61,25 @@ def _serve_json(host, port, post_routes, get_routes):
             if fn is None:
                 self._reply(404, {"error": "unknown endpoint"})
                 return
+            mon = monitoring.serving_monitor()
+            if mon is None:
+                try:
+                    self._reply(200, fn(body))
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    self._reply(400, {"error": str(e)})
+                return
+            mon.in_flight.inc()
+            t0 = time.perf_counter()
+            code = 200
             try:
-                self._reply(200, fn(body))
+                payload = fn(body)
             except Exception as e:  # noqa: BLE001 — serving boundary
-                self._reply(400, {"error": str(e)})
+                code, payload = 400, {"error": str(e)}
+            finally:
+                mon.in_flight.dec()
+            mon.request_seconds.labels(route=path, code=code).observe(
+                time.perf_counter() - t0)
+            self._reply(code, payload)
 
         def do_POST(self):  # noqa: N802
             try:
@@ -71,6 +91,15 @@ def _serve_json(host, port, post_routes, get_routes):
             self._route(post_routes, body)
 
         def do_GET(self):  # noqa: N802
+            if self.path.split("?")[0] == "/metrics":
+                data = monitoring.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             self._route(get_routes, {})
 
         def log_message(self, *args):

@@ -7,7 +7,9 @@ endpoint every couple of seconds and redraws loss curves plus per-layer
 weight/update histogram time series (latest distribution as bars, history
 as a heatmap) on canvases — live while training runs, the
 attach-storage-then-browse workflow (UIServer.getInstance().attach(...)).
-"/report" keeps the static inline-SVG snapshot.
+"/report" keeps the static inline-SVG snapshot; "/metrics" exposes the
+process-wide monitoring registry in Prometheus text format (same body the
+serving servers expose — one scrape config covers training and serving).
 """
 
 from __future__ import annotations
@@ -263,6 +265,11 @@ class UIServer:
                     body = "".join(render_report(s) for s in storages) or (
                         "<html><body>no storage attached</body></html>")
                     self._send(body.encode(), "text/html; charset=utf-8")
+                elif path == "/metrics":
+                    from deeplearning4j_tpu import monitoring
+
+                    self._send(monitoring.metrics_text().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -10,6 +10,7 @@ written with orbax so multi-host sharded arrays save/restore correctly.
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -42,7 +43,24 @@ class TrainingCheckpointer:
 
         payload = {"params": model.params, "state": model.state,
                    "opt_state": model.opt_state}
-        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        from deeplearning4j_tpu import monitoring
+
+        mon = monitoring.checkpoint_monitor()
+        if mon is None:
+            self._mgr.save(step, args=ocp.args.StandardSave(payload))
+            return
+        import jax
+
+        nbytes = sum(getattr(leaf, "nbytes", 0)
+                     for leaf in jax.tree_util.tree_leaves(payload))
+        with monitoring.span("checkpoint.save", step=step, bytes=nbytes):
+            t0 = time.perf_counter()
+            self._mgr.save(step, args=ocp.args.StandardSave(payload))
+            # async saves: this is the SUBMIT cost the fit loop pays; the
+            # background write finishes under wait()
+            mon.save_seconds.observe(time.perf_counter() - t0)
+        mon.saved_bytes.inc(nbytes)
+        mon.saves.inc()
 
     def wait(self):
         self._mgr.wait_until_finished()

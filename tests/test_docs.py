@@ -129,6 +129,25 @@ train_iterator = ArrayDataSetIterator(
     np.eye(4, dtype=np.float32)[_rng.integers(0, 4, 64)], batch_size=16)
 """,
     "rl.md": "",
+    "observability.md": """
+import numpy as np
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+_conf = (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_out=8, activation="relu"))
+         .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.feed_forward(4)).build())
+model = MultiLayerNetwork(_conf).init()
+_rng = np.random.default_rng(0)
+iterator = ArrayDataSetIterator(
+    _rng.normal(size=(32, 4)).astype(np.float32),
+    np.eye(3, dtype=np.float32)[_rng.integers(0, 3, 32)], batch_size=8)
+val_iterator = ArrayDataSetIterator(
+    _rng.normal(size=(16, 4)).astype(np.float32),
+    np.eye(3, dtype=np.float32)[_rng.integers(0, 3, 16)], batch_size=8)
+""",
     "nlp.md": """
 import os
 with open("vocab.txt", "w") as f:
@@ -174,7 +193,16 @@ SHRINK = {
 }
 
 
-@pytest.mark.parametrize("doc", sorted(p.name for p in DOCS.glob("*.md")))
+# compile-heavy guides (8-way-mesh ring/zigzag attention) leave the quick
+# tier; `-m slow` still runs them
+_SLOW_DOCS = {"long_context.md"}
+
+
+@pytest.mark.parametrize(
+    "doc",
+    [pytest.param(p.name,
+                  marks=[pytest.mark.slow] if p.name in _SLOW_DOCS else [])
+     for p in sorted(DOCS.glob("*.md"))])
 def test_doc_snippets_execute(doc, tmp_path, monkeypatch):
     blocks = _blocks(DOCS / doc)
     if not blocks:
@@ -185,10 +213,17 @@ def test_doc_snippets_execute(doc, tmp_path, monkeypatch):
     if setup:
         exec(compile(setup.replace("{fx}", str(FIXTURES)),
                      f"docs/{doc}:setup", "exec"), ns)
-    for i, (info, src) in enumerate(blocks):
-        for old, new in SHRINK.get(doc, []):
-            src = src.replace(old, new)
-        if "notest" in info:
-            ast.parse(src)          # syntax-checked, not executed
-            continue
-        exec(compile(src, f"docs/{doc}:block{i}", "exec"), ns)
+    try:
+        for i, (info, src) in enumerate(blocks):
+            for old, new in SHRINK.get(doc, []):
+                src = src.replace(old, new)
+            if "notest" in info:
+                ast.parse(src)          # syntax-checked, not executed
+                continue
+            exec(compile(src, f"docs/{doc}:block{i}", "exec"), ns)
+    finally:
+        # guides may flip global monitoring switches (observability.md);
+        # restore the env-default state for the rest of the suite
+        from deeplearning4j_tpu import monitoring
+
+        monitoring.reset()

@@ -5,6 +5,7 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
 
@@ -47,6 +48,7 @@ def test_samediff_training(tmp_path):
     assert loss < 0.05
 
 
+@pytest.mark.slow  # ~15s: ring-attention example compiles the 8-way mesh
 def test_long_context():
     import long_context
 
@@ -62,6 +64,7 @@ def test_imagenet_pipeline():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow  # ~35s: zigzag example compiles the 8-way permuted mesh
 def test_long_context_zigzag():
     import long_context_zigzag
 

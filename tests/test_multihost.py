@@ -15,6 +15,7 @@ import textwrap
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 # r2: in the default suite. The r1 opt-in skip blamed Gloo handshake races,
 # but the actual stall was dispatch-queue depth: hundreds of ASYNC-dispatched
@@ -36,7 +37,7 @@ info = initialize_distributed(coordinator_address=f"127.0.0.1:{port}",
 assert info["process_count"] == 2, info
 assert info["global_devices"] == 8, info
 import numpy as np, jax.numpy as jnp
-from jax import shard_map
+from deeplearning4j_tpu.parallel._compat import shard_map  # jax-version shim
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 devs = np.array(jax.devices()).reshape(8)
 mesh = Mesh(devs, ("data",))
@@ -178,6 +179,7 @@ print(f"DONE pid={pid}", flush=True)
 """)
 
 
+@pytest.mark.slow  # ~100s: two spawned processes compile the full stack
 def test_two_process_framework_stack(tmp_path):
     worker = tmp_path / "worker_fw.py"
     worker.write_text(_FRAMEWORK_WORKER)

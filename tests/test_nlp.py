@@ -884,6 +884,23 @@ class TestWordVectorSerializer:
         assert words == ["alpha", "beta"]
         np.testing.assert_array_equal(W, [[1, 2, 3], [4, 5, 6]])
 
+    def test_headerless_first_word_with_space(self, tmp_path):
+        """ADVICE r5: a headerless file whose FIRST word contains a space
+        must infer D from the trailing float fields, not mis-split every
+        row."""
+        from deeplearning4j_tpu.nlp import read_word_vectors
+
+        p = tmp_path / "multi.txt"
+        p.write_text("new york 1 2 3\nparis 4 5 6\n")
+        words, W = read_word_vectors(str(p))
+        assert words == ["new york", "paris"]
+        np.testing.assert_array_equal(W, [[1, 2, 3], [4, 5, 6]])
+        # no trailing floats at all on the first line fails loud
+        bad = tmp_path / "nofloats.txt"
+        bad.write_text("just words here\n")
+        with pytest.raises(ValueError, match="no trailing float"):
+            read_word_vectors(str(bad))
+
     def test_text_reader_fails_loud_on_malformed_input(self, tmp_path):
         from deeplearning4j_tpu.nlp import read_word_vectors
 
