@@ -91,14 +91,19 @@ class AsyncPrefetchIterator(DataSetIterator):
         self.inner = inner
         self.queue_size = queue_size
         self.device_put = device_put
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
 
     def _produce(self):
         q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
         _END = object()
 
         def worker():
             try:
                 for ds in self.inner:
+                    if stop.is_set():
+                        return
                     if self.device_put:
                         import jax
 
@@ -107,18 +112,57 @@ class AsyncPrefetchIterator(DataSetIterator):
                             None if ds.features_mask is None else jax.device_put(ds.features_mask),
                             None if ds.labels_mask is None else jax.device_put(ds.labels_mask),
                         )
-                    q.put(ds)
+                    # bounded put, re-checking stop: a consumer that
+                    # abandons the generator mid-epoch would otherwise
+                    # leave this thread blocked on a full queue forever
+                    # (thread + pinned device batches leaked)
+                    while not stop.is_set():
+                        try:
+                            q.put(ds, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             finally:
-                q.put(_END)
+                # deliver _END unless the consumer already hung up (stop):
+                # a live-but-slow consumer must still see the sentinel
+                while not stop.is_set():
+                    try:
+                        q.put(_END, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True)
+        self._stop, self._thread = stop, t
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                yield item
+            t.join()
+        finally:
+            # normal exhaustion, consumer abandonment (GeneratorExit), or
+            # an exception downstream: stop the producer and unblock any
+            # pending put so the thread exits
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
+
+    def close(self):
+        """Stop the prefetch thread without consuming the iterator (the
+        explicit form of abandoning the generator)."""
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
     def reset(self):
         self.inner.reset()

@@ -6,7 +6,7 @@ memory/GC reporting. Here the two production-grade halves it lacked:
 
 - a process-wide **MetricsRegistry** (Counter / Gauge / Histogram, labeled,
   thread-safe) with Prometheus text exposition, scraped from ``GET
-  /metrics`` on both the UI server and the serving server;
+  /metrics`` on both the UI server and every serving/ server;
 - a host-side **SpanTracer** (``span("name")`` context manager, nestable,
   thread-aware) emitting Chrome trace-event JSON for Perfetto — the HOST
   timeline complementing ``profiler.trace()``'s device timeline.
@@ -176,7 +176,10 @@ class _FitMonitor:
 
 class _ServingMonitor:
     """Serving-tier instruments: request latency by route/status, in-flight
-    and queue-depth gauges, device batch-size distribution."""
+    and queue-depth gauges, device batch-size distribution — plus the
+    gateway's per-model/per-version tier: predict latency, load-shed
+    counters by reason (queue_full / deadline / draining), per-model queue
+    depth, warmup compile durations, and a loaded-version gauge."""
 
     def __init__(self, reg: MetricsRegistry):
         self.reg = reg
@@ -191,6 +194,28 @@ class _ServingMonitor:
         self.queue_depth = reg.gauge(
             "dl4j_serving_queue_depth",
             "Pending requests in the batching queue at dispatch")
+        # ---- gateway (per-model) tier ----
+        self.model_request_seconds = reg.histogram(
+            "dl4j_serving_model_request_seconds",
+            "Gateway predict latency per model/version/status",
+            labels=("model", "version", "code"))
+        self.shed_total = reg.counter(
+            "dl4j_serving_shed_total",
+            "Requests shed by admission control, by reason",
+            labels=("model", "reason"))
+        self.model_queue_depth = reg.gauge(
+            "dl4j_serving_model_queue_depth",
+            "Admitted-but-undispatched requests per model worker",
+            labels=("model", "version"))
+        self.warmup_seconds = reg.histogram(
+            "dl4j_serving_warmup_seconds",
+            "Per-bucket warmup (compile+run) duration at model load",
+            labels=("model", "version"),
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+        self.model_loaded = reg.gauge(
+            "dl4j_serving_model_loaded",
+            "1 while the (model, version) is registered and servable",
+            labels=("model", "version"))
 
 
 class _LocalSgdMonitor:

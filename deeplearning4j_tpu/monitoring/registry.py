@@ -5,7 +5,7 @@ StatsStorage -> UIServer, i.e. a push pipeline with storage as the only
 aggregation point. Production serving needs the pull model instead: a
 process-wide registry of named instruments (Counter / Gauge / Histogram,
 optionally labeled) that any subsystem writes into and a scrape endpoint
-(``GET /metrics`` on ui/server.py and serving.py) reads out in the
+(``GET /metrics`` on ui/server.py and the serving/ tier) reads out in the
 Prometheus text format. One registry is the single source of truth for the
 fit loop, local-SGD rounds, the serving tier, and checkpoints.
 

@@ -340,15 +340,27 @@ class Word2Vec:
 
     @staticmethod
     def _ascii_sample(path: str, limit: int = 1 << 20) -> bool:
-        """True when the first ``limit`` bytes are pure ASCII. The native
-        tokenizer only matches the Python one (lowercase + [^\\w\\s] strip)
-        for ASCII text — non-ASCII bytes pass through unlowercased and
-        unicode punctuation survives — so AUTO selection requires an ASCII
-        sample; ``native_front=True`` overrides (byte-level semantics,
-        documented in nlp.native_text)."""
+        """True when ``limit`` bytes sampled at the file's head, middle,
+        and tail are pure ASCII (ADVICE r5: head-only sampling let late
+        non-ASCII content ride the native front and silently diverge the
+        vocabulary). The native tokenizer only matches the Python one
+        (lowercase + [^\\w\\s] strip) for ASCII text — non-ASCII bytes pass
+        through unlowercased and unicode punctuation survives — so AUTO
+        selection requires ASCII samples; ``native_front=True`` overrides
+        (byte-level semantics, documented in nlp.native_text)."""
+        size = os.path.getsize(path)
+        if size <= limit:
+            offsets, chunk = [0], limit
+        else:
+            chunk = limit // 3
+            offsets = [0, max(0, size // 2 - chunk // 2), size - chunk]
         with open(path, "rb") as f:
-            head = f.read(limit)
-        return not head or max(head) < 0x80
+            for off in offsets:
+                f.seek(off)
+                sample = f.read(chunk)
+                if sample and max(sample) >= 0x80:
+                    return False
+        return True
 
     def _lr_at(self, words_done: int, total_words: int) -> float:
         """Linear lr decay over the run's in-vocab words (the reference's

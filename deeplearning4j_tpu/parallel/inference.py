@@ -6,13 +6,23 @@ them over per-device model replicas (INPLACE / BATCHED modes).
 
 TPU-native: one jitted forward sharded over the mesh's data axis does the
 replica fan-out; the host-side piece that survives is the batching queue.
+
+Serving-gateway extensions (PR 2): the queue can be bounded (``max_queue``,
+admission control maps ``queue.Full`` to HTTP 429), every request can carry
+a monotonic-clock ``deadline`` (expired requests are shed at dispatch time
+and resolved with a :class:`DeadlineExceeded` instead of blocking their
+caller forever), forward-pass errors are fanned back to every waiter of the
+batch instead of silently killing the worker thread, and ``stop(drain=True)``
+flushes already-admitted requests before joining — the graceful-drain half
+of the gateway lifecycle.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -20,16 +30,35 @@ from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
 
+class DeadlineExceeded(Exception):
+    """Posted to a request's result queue when its deadline passed before
+    dispatch. Callers that submit with deadlines must check ``get()``
+    results with :func:`resolve`."""
+
+
+def resolve(result):
+    """Turn a result-queue item into a value: raises when the worker posted
+    an exception (deadline shed or forward-pass failure)."""
+    if isinstance(result, BaseException):
+        raise result
+    return result
+
+
 class ParallelInference:
     """Batched inference server around a model's output().
 
     batch_limit: max requests coalesced into one device batch;
-    queue_timeout_s: max wait to fill a batch before running partial.
+    queue_timeout_s: max wait to fill a batch before running partial;
+    max_queue: bound on admitted-but-undispatched requests (0 = unbounded;
+    when full, ``submit`` raises ``queue.Full`` — backpressure, not pile-up);
+    on_shed: optional callback(n) invoked when n deadline-expired requests
+    are shed at dispatch.
     """
 
     def __init__(self, model, mesh: Optional[DeviceMesh] = None,
                  batch_limit: int = 32, queue_timeout_s: float = 0.005,
-                 pad_batches: bool = True):
+                 pad_batches: bool = True, max_queue: int = 0,
+                 on_shed: Optional[Callable[[int], None]] = None):
         self.model = model
         self.mesh = mesh
         self.batch_limit = batch_limit
@@ -40,9 +69,12 @@ class ParallelInference:
         # observed batch size (a retrace storm under bursty load — every
         # new size stalled its whole batch behind an XLA compile)
         self.pad_batches = pad_batches
-        self._q: queue.Queue = queue.Queue()
+        self.max_queue = max_queue
+        self.on_shed = on_shed
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._accepting = False
 
     # --- synchronous one-shot API (ParallelInference.output) ---
     def output(self, x):
@@ -54,19 +86,46 @@ class ParallelInference:
     # --- async batched API ---
     def start(self):
         self._stop.clear()
+        self._accepting = True
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
         return self
 
-    def stop(self):
+    def stop(self, drain: bool = False, timeout: float = 30.0):
+        """Stop the worker. ``drain=True`` first stops admitting, flushes
+        every already-queued request (bounded by ``timeout``), and only
+        then joins — in-flight work completes instead of being orphaned."""
+        self._accepting = False
+        if drain and self._worker is not None and self._worker.is_alive():
+            end = time.monotonic() + timeout
+            while not self._q.empty() and time.monotonic() < end:
+                time.sleep(0.005)
         self._stop.set()
         if self._worker:
-            self._worker.join(timeout=5)
+            self._worker.join(timeout=max(5.0, timeout))
 
-    def submit(self, x) -> "queue.Queue":
-        """Submit one example [features...] -> a result queue of size 1."""
+    def drain(self, timeout: float = 30.0):
+        """Graceful shutdown: stop admitting, flush, join."""
+        self.stop(drain=True, timeout=timeout)
+
+    def backlog(self) -> int:
+        """Admitted-but-undispatched request count (approximate)."""
+        return self._q.qsize()
+
+    def submit(self, x, deadline: Optional[float] = None) -> "queue.Queue":
+        """Submit one example [features...] -> a result queue of size 1.
+
+        ``deadline``: optional ``time.monotonic()`` instant; a request still
+        undispatched past it is resolved with :class:`DeadlineExceeded`
+        rather than executed. Raises ``queue.Full`` when a bounded queue is
+        at capacity and ``RuntimeError`` when the server is not accepting
+        (stopped or draining).
+        """
+        if not self._accepting:
+            raise RuntimeError("ParallelInference is not accepting requests "
+                               "(stopped or draining)")
         out: queue.Queue = queue.Queue(maxsize=1)
-        self._q.put((np.asarray(x), out))
+        self._q.put_nowait((np.asarray(x), out, deadline))
         return out
 
     def _run(self):
@@ -81,18 +140,40 @@ class ParallelInference:
                     batch.append(self._q.get(timeout=self.queue_timeout_s))
                 except queue.Empty:
                     break
+            # shed deadline-expired requests BEFORE dispatch: their callers
+            # get an immediate DeadlineExceeded instead of riding (and
+            # paying for) a device batch whose result nobody will read
+            now = time.monotonic()
+            live, shed = [], 0
+            for item in batch:
+                if item[2] is not None and now > item[2]:
+                    item[1].put(DeadlineExceeded(
+                        "deadline passed before dispatch"))
+                    shed += 1
+                else:
+                    live.append(item)
+            if shed and self.on_shed is not None:
+                self.on_shed(shed)
+            if not live:
+                continue
             mon = monitoring.serving_monitor()
             if mon is not None:
                 # batch-size distribution + queue backlog at dispatch time
-                mon.batch_size.observe(len(batch))
+                mon.batch_size.observe(len(live))
                 mon.queue_depth.set(self._q.qsize())
-            xs = np.stack([b[0] for b in batch])
+            xs = np.stack([b[0] for b in live])
             n = xs.shape[0]
             if self.pad_batches and n > 1:
                 bucket = min(1 << (n - 1).bit_length(), self.batch_limit)
                 if bucket > n:
                     pad = np.zeros((bucket - n,) + xs.shape[1:], xs.dtype)
                     xs = np.concatenate([xs, pad])
-            ys = np.asarray(self.output(xs))[:n]
-            for (x, out), y in zip(batch, ys):
+            try:
+                ys = np.asarray(self.output(xs))[:n]
+            except Exception as e:  # noqa: BLE001 — fan the failure back to
+                # every waiter; a dead worker thread would block them forever
+                for _, out, _ in live:
+                    out.put(e)
+                continue
+            for (x, out, _), y in zip(live, ys):
                 out.put(y)

@@ -1,0 +1,34 @@
+"""Production serving subsystem.
+
+Grew out of the single-model ``serving.py`` (kept importable here unchanged:
+``ModelServer``, ``KNNServer``) into a real serving tier:
+
+- :mod:`~deeplearning4j_tpu.serving.gateway` — :class:`ServingGateway`, the
+  multi-model HTTP front: per-model ``POST /v1/<name>/predict``, admin
+  ``POST /models/*`` routes, ``/healthz`` / ``/readyz``, graceful drain;
+- :mod:`~deeplearning4j_tpu.serving.registry` — named + versioned models,
+  hot load/unload/reload, weighted canary traffic splits;
+- :mod:`~deeplearning4j_tpu.serving.admission` — bounded queues, per-request
+  deadlines, 429/503/504 backpressure, load-shed counters;
+- :mod:`~deeplearning4j_tpu.serving.warmup` — pad-to-bucket batch shapes
+  precompiled at model load, so no request pays a cold XLA compile;
+- :mod:`~deeplearning4j_tpu.serving.http` — stdlib JSON-over-HTTP
+  scaffolding (+ ``GET /metrics`` Prometheus exposition on every server).
+
+See ``docs/serving.md`` for routes, admission knobs, and a canary example.
+"""
+
+from deeplearning4j_tpu.serving.admission import AdmissionController
+from deeplearning4j_tpu.serving.gateway import ServingGateway
+from deeplearning4j_tpu.serving.http import HttpError, serve_json, _serve_json, _HttpServerMixin
+from deeplearning4j_tpu.serving.legacy import KNNServer, ModelServer
+from deeplearning4j_tpu.serving.registry import ModelRegistry, ModelVersion
+from deeplearning4j_tpu.serving.warmup import (bucket_for, pow2_buckets,
+                                               warmup_model)
+
+__all__ = [
+    "ServingGateway", "ModelRegistry", "ModelVersion",
+    "AdmissionController", "HttpError", "serve_json",
+    "ModelServer", "KNNServer",
+    "pow2_buckets", "bucket_for", "warmup_model",
+]

@@ -1,0 +1,122 @@
+"""Admission control: bounded queues, deadlines, and load shedding.
+
+The failure mode this kills: an overloaded single-queue server accepts every
+request, the queue grows without bound, every response is late, and nothing
+in /metrics says why. Here admission is explicit — each model's worker queue
+is bounded, a request that can't be admitted is REJECTED NOW (HTTP 429 with
+``Retry-After``) instead of piling up, every admitted request carries a
+deadline (expired ones are shed at dispatch and answered 504), and every
+shed increments a per-model, per-reason counter so overload is visible the
+moment it starts.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.parallel.inference import DeadlineExceeded
+from deeplearning4j_tpu.serving.http import HttpError
+from deeplearning4j_tpu.serving.registry import ModelVersion
+
+
+class AdmissionController:
+    """Per-request admission policy for the gateway.
+
+    default_timeout_s / max_timeout_s: request deadline bounds (requests may
+    pass ``timeout_ms`` in the body, clamped to the max);
+    retry_after_s: the backpressure hint on 429 responses.
+    """
+
+    def __init__(self, default_timeout_s: float = 30.0,
+                 max_timeout_s: float = 300.0,
+                 retry_after_s: float = 1.0):
+        self.default_timeout_s = default_timeout_s
+        self.max_timeout_s = max_timeout_s
+        self.retry_after_s = retry_after_s
+
+    # ------------------------------------------------------------ deadline
+    def timeout_for(self, body: dict) -> float:
+        """The request's timeout budget in seconds (body ``timeout_ms``
+        overrides the default, clamped to [1 ms, max])."""
+        ms = body.get("timeout_ms")
+        if ms is None:
+            return self.default_timeout_s
+        return min(max(float(ms) / 1000.0, 0.001), self.max_timeout_s)
+
+    def _shed(self, model: str, reason: str, n: int = 1):
+        mon = monitoring.serving_monitor()
+        if mon is not None:
+            mon.shed_total.labels(model=model, reason=reason).inc(n)
+
+    def _retry_headers(self) -> dict:
+        return {"Retry-After": str(max(1, math.ceil(self.retry_after_s)))}
+
+    # -------------------------------------------------------------- submit
+    def submit(self, mv: ModelVersion, xs: np.ndarray,
+               deadline: float) -> List["queue.Queue"]:
+        """Admit every row of ``xs`` to ``mv``'s worker, or reject with a
+        429 (queue full) / 503 (worker draining). Capacity for the WHOLE
+        request is checked up front so a rejected multi-row request does
+        not half-admit; rows that slip through the precheck race keep
+        their deadline, so the worker eventually sheds them rather than
+        holding them forever."""
+        cap = mv.pi.max_queue
+        if cap and mv.pi.backlog() + len(xs) > cap:
+            self._shed(mv.name, "queue_full")
+            raise HttpError(
+                429, f"model {mv.name!r} queue is full ({cap} pending); "
+                "retry later", headers=self._retry_headers())
+        queues = []
+        for x in xs:
+            try:
+                queues.append(mv.pi.submit(x, deadline=deadline))
+            except queue.Full:
+                self._shed(mv.name, "queue_full")
+                raise HttpError(
+                    429, f"model {mv.name!r} queue is full "
+                    f"({mv.pi.max_queue} pending); retry later",
+                    headers=self._retry_headers()) from None
+            except RuntimeError:
+                # worker draining (hot reload / shutdown race)
+                self._shed(mv.name, "draining")
+                raise HttpError(
+                    503, f"model {mv.name!r} version {mv.version!r} is "
+                    "draining; retry", headers=self._retry_headers()) from None
+        mon = monitoring.serving_monitor()
+        if mon is not None:
+            mon.model_queue_depth.labels(
+                model=mv.name, version=mv.version).set(mv.pi.backlog())
+        return queues
+
+    # -------------------------------------------------------------- gather
+    def gather(self, mv: ModelVersion, queues: List["queue.Queue"],
+               deadline: float) -> List[np.ndarray]:
+        """Collect every result before the deadline; a timeout or a
+        deadline-shed result is a 504 (the remaining siblings carry the
+        same deadline — the worker cancels them, nothing is orphaned)."""
+        outs = []
+        for q in queues:
+            remaining = deadline - time.monotonic()
+            try:
+                r = q.get(timeout=max(remaining, 0.001))
+            except queue.Empty:
+                self._shed(mv.name, "deadline")
+                raise HttpError(
+                    504, f"model {mv.name!r} deadline exceeded "
+                    "waiting for result") from None
+            if isinstance(r, DeadlineExceeded):
+                # worker-side shed already counted via on_shed
+                raise HttpError(
+                    504, f"model {mv.name!r} deadline exceeded "
+                    "before dispatch") from None
+            if isinstance(r, BaseException):
+                raise HttpError(500, f"model {mv.name!r} forward pass "
+                                f"failed: {r}") from None
+            outs.append(np.asarray(r))
+        return outs

@@ -1,0 +1,238 @@
+"""The production serving gateway: registry + admission + warmup + lifecycle.
+
+One HTTP server multiplexing many named, versioned models:
+
+    POST /v1/<name>/predict   {"inputs": [[...]], "timeout_ms": 250}
+    POST /models/load         {"name", "version", "path", "weight",
+                               "warmup_shape", "batch_limit"}
+    POST /models/reload       (same body — hot swap, zero dropped requests)
+    POST /models/unload       {"name", "version"?}
+    POST /models/split        {"name", "split": {"v1": 0.9, "v2": 0.1}}
+    GET  /models              registry + splits + backlogs
+    GET  /healthz             process liveness (200 once the server is up)
+    GET  /readyz              traffic readiness (503 until a model is
+                              loaded, and again once draining)
+    GET  /metrics             Prometheus exposition (process-wide registry)
+
+Admission outcomes a client sees: 200 (served), 429 + ``Retry-After``
+(queue full — back off), 503 (no servable model, or draining), 504
+(deadline exceeded), 500 (model forward failed), 404 (unknown model).
+
+Lifecycle: ``stop()`` is a graceful drain — stop admitting (``/readyz``
+goes 503 so balancers eject the instance), wait for in-flight requests,
+flush every model's worker queue, then join. Nothing admitted is dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.serving.admission import AdmissionController
+from deeplearning4j_tpu.serving.http import (HttpError, _HttpServerMixin,
+                                             serve_json)
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+
+def _match_predict(path: str):
+    """/v1/<name>/predict -> {"name": name} (None = no match)."""
+    parts = path.strip("/").split("/")
+    if len(parts) == 3 and parts[0] == "v1" and parts[2] == "predict":
+        return {"name": parts[1]}
+    return None
+
+
+class ServingGateway(_HttpServerMixin):
+    """Multi-model serving gateway.
+
+        gw = ServingGateway(port=0).start()
+        gw.register_model("mnist", "v1", model, warmup_shape=(28, 28, 1))
+        ... POST http://host:port/v1/mnist/predict {"inputs": [...]}
+        gw.stop()          # graceful drain
+
+    ``admin=False`` disables the mutating /models/* routes (predict-only
+    data plane); the Python API (register_model/unload_model/set_split)
+    always works.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 batch_limit: int = 32, max_queue: int = 128,
+                 queue_timeout_s: float = 0.005,
+                 default_timeout_s: float = 30.0,
+                 retry_after_s: float = 1.0,
+                 seed: Optional[int] = None, admin: bool = True):
+        self._host, self._port = host, port
+        self.admin = admin
+        self.registry = ModelRegistry(
+            batch_limit=batch_limit, max_queue=max_queue,
+            queue_timeout_s=queue_timeout_s, seed=seed)
+        self.admission = AdmissionController(
+            default_timeout_s=default_timeout_s,
+            retry_after_s=retry_after_s)
+        self._draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Condition(self._inflight_lock)
+
+    # ------------------------------------------------------- python API
+    def register_model(self, name: str, version: str, model, *,
+                       weight: Optional[float] = None,
+                       warmup_shape: Optional[Sequence[int]] = None,
+                       warmup: bool = True,
+                       batch_limit: Optional[int] = None,
+                       max_queue: Optional[int] = None):
+        """Load (or hot-reload) a servable version; warmed before it takes
+        traffic. See :meth:`ModelRegistry.load`."""
+        return self.registry.load(
+            name, version, model, weight=weight, warmup_shape=warmup_shape,
+            warmup=warmup, batch_limit=batch_limit, max_queue=max_queue)
+
+    def unload_model(self, name: str, version: Optional[str] = None):
+        return self.registry.unload(name, version)
+
+    def set_split(self, name: str, weights):
+        return self.registry.set_split(name, weights)
+
+    # --------------------------------------------------------- handlers
+    def _track(self, delta: int):
+        with self._inflight_lock:
+            self._inflight += delta
+            if self._inflight == 0:
+                self._idle.notify_all()
+
+    def _predict(self, params, body):
+        if self._draining:
+            raise HttpError(503, "gateway is draining",
+                            headers=self.admission._retry_headers())
+        name = params["name"]
+        self._track(+1)
+        try:
+            return self._predict_inner(name, body)
+        finally:
+            self._track(-1)
+
+    def _predict_inner(self, name: str, body: dict):
+        try:
+            mv = self.registry.route(name)
+        except KeyError:
+            raise HttpError(404, f"model {name!r} is not registered") from None
+        xs = np.asarray(body["inputs"], np.float32)
+        if xs.ndim < 1 or xs.shape[0] == 0:
+            raise HttpError(400, "inputs must be a non-empty batch")
+        timeout = self.admission.timeout_for(body)
+        deadline = time.monotonic() + timeout
+        t0 = time.perf_counter()
+        code = 200
+        try:
+            try:
+                queues = self.admission.submit(mv, xs, deadline)
+            except HttpError as e:
+                if e.code != 503:
+                    raise
+                # the routed version started draining under us (hot reload /
+                # unload race): re-route once — the registry swap is atomic,
+                # so the retry sees the replacement. This is what makes hot
+                # reload zero-drop.
+                mv = self.registry.route(name)
+                queues = self.admission.submit(mv, xs, deadline)
+            outs = self.admission.gather(mv, queues, deadline)
+            return {"outputs": [y.tolist() for y in outs],
+                    "model": mv.name, "version": mv.version}
+        except HttpError as e:
+            code = e.code
+            raise
+        except Exception:
+            code = 400
+            raise
+        finally:
+            mon = monitoring.serving_monitor()
+            if mon is not None:
+                mon.model_request_seconds.labels(
+                    model=name, version=mv.version, code=code).observe(
+                    time.perf_counter() - t0)
+
+    # ----------------------------------------------------- admin routes
+    def _require(self, body: dict, *keys):
+        missing = [k for k in keys if not body.get(k)]
+        if missing:
+            raise HttpError(400, f"missing field(s): {', '.join(missing)}")
+
+    def _load_route(self, body: dict):
+        self._require(body, "name", "version", "path")
+        from deeplearning4j_tpu.util.serialization import restore_model
+
+        model = restore_model(body["path"], load_updater=False)
+        shape = body.get("warmup_shape")
+        mv = self.registry.load(
+            body["name"], body["version"], model,
+            weight=body.get("weight"),
+            warmup_shape=None if shape is None else tuple(shape),
+            warmup=bool(body.get("warmup", True)),
+            batch_limit=body.get("batch_limit"),
+            max_queue=body.get("max_queue"))
+        return {"loaded": mv.describe()}
+
+    def _unload_route(self, body: dict):
+        self._require(body, "name")
+        try:
+            removed = self.registry.unload(body["name"], body.get("version"))
+        except KeyError as e:
+            raise HttpError(404, str(e)) from None
+        return {"unloaded": [mv.describe() for mv in removed]}
+
+    def _split_route(self, body: dict):
+        self._require(body, "name", "split")
+        try:
+            split = self.registry.set_split(body["name"], body["split"])
+        except KeyError as e:
+            raise HttpError(404, str(e)) from None
+        return {"split": split}
+
+    def _readyz(self, _body):
+        if self._draining:
+            raise HttpError(503, "draining")
+        if not self.registry.ready():
+            raise HttpError(503, "no model loaded")
+        return {"ready": True, "models": self.registry.names()}
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ServingGateway":
+        self._draining = False
+        post_routes = {}
+        if self.admin:
+            post_routes.update({
+                "/models/load": self._load_route,
+                "/models/reload": self._load_route,
+                "/models/unload": self._unload_route,
+                "/models/split": self._split_route,
+            })
+        self._httpd, self._thread = serve_json(
+            self._host, self._port,
+            post_routes=post_routes,
+            get_routes={
+                "/healthz": lambda _: {"status": "alive"},
+                "/readyz": self._readyz,
+                "/models": lambda _: {"models": self.registry.describe()},
+            },
+            dynamic_post=[("/v1/*/predict", _match_predict, self._predict)])
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Graceful drain: stop admitting (new predicts get 503, /readyz
+        flips), wait for in-flight HTTP requests, flush every model worker,
+        then shut the listener down. ``drain=False`` hard-stops."""
+        self._draining = True
+        if drain:
+            end = time.monotonic() + timeout
+            with self._inflight_lock:
+                while self._inflight > 0:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._idle.wait(timeout=remaining)
+        self._stop_httpd()
+        self.registry.shutdown(drain=drain)

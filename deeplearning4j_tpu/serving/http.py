@@ -1,0 +1,169 @@
+"""JSON-over-HTTP scaffolding shared by every serving endpoint.
+
+Stdlib-only (ThreadingHTTPServer): routes are ``{path: fn(body) -> payload}``
+plus *dynamic* routes — ``(label, match_fn, handler)`` triples for
+parameterized paths like ``/v1/<model>/predict`` — so the gateway can route
+per-model without registering a handler per model. Handlers signal
+non-200 outcomes by raising :class:`HttpError` (status code + optional
+response headers, e.g. ``Retry-After`` on 429 backpressure); any other
+exception is a 400 at the serving boundary.
+
+Every server also answers ``GET /metrics`` with the process-wide Prometheus
+exposition, and — when monitoring is enabled — records per-route request
+latency and an in-flight gauge. Dynamic routes are observed under their
+*label* (``/v1/*/predict``), not the raw path, so metric cardinality stays
+bounded no matter how many models are registered.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu import monitoring
+
+
+class HttpError(Exception):
+    """A handler-raised HTTP outcome: status code, JSON error payload, and
+    optional extra response headers (e.g. ``{"Retry-After": "1"}``)."""
+
+    def __init__(self, code: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
+        super().__init__(message)
+        self.code = int(code)
+        self.message = message
+        self.headers = dict(headers or {})
+
+
+class _HttpServerMixin:
+    """Shared ephemeral-port resolution and shutdown for the HTTP servers."""
+
+    _httpd = None
+    _thread = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def _stop_httpd(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+# (label-for-metrics, path -> params-or-None, handler(params, body))
+DynamicRoute = Tuple[str, Callable[[str], Optional[dict]],
+                     Callable[[dict, dict], dict]]
+
+
+def serve_json(host, port, post_routes, get_routes,
+               dynamic_post: Optional[List[DynamicRoute]] = None,
+               dynamic_get: Optional[List[DynamicRoute]] = None):
+    """Start a threaded JSON HTTP server; returns (httpd, thread) — call
+    httpd.shutdown()/server_close() to stop."""
+    dynamic_post = dynamic_post or []
+    dynamic_get = dynamic_get or []
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code, payload, headers=None):
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _match(self, routes, dynamic):
+            path = self.path.split("?")[0]
+            fn = routes.get(path)
+            if fn is not None:
+                return path, fn
+            for label, match, handler in dynamic:
+                params = match(path)
+                if params is not None:
+                    return label, (lambda body, h=handler, p=params: h(p, body))
+            return path, None
+
+        def _route(self, routes, dynamic, body):
+            label, fn = self._match(routes, dynamic)
+            if fn is None:
+                self._reply(404, {"error": "unknown endpoint"})
+                return
+            mon = monitoring.serving_monitor()
+            if mon is None:
+                try:
+                    self._reply(200, fn(body))
+                except HttpError as e:
+                    self._reply(e.code, {"error": e.message}, e.headers)
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    self._reply(400, {"error": str(e)})
+                return
+            mon.in_flight.inc()
+            t0 = time.perf_counter()
+            code, headers = 200, None
+            try:
+                payload = fn(body)
+            except HttpError as e:
+                code, payload, headers = e.code, {"error": e.message}, e.headers
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                code, payload = 400, {"error": str(e)}
+            finally:
+                mon.in_flight.dec()
+            mon.request_seconds.labels(route=label, code=code).observe(
+                time.perf_counter() - t0)
+            self._reply(code, payload, headers)
+
+        def do_POST(self):  # noqa: N802
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except Exception as e:  # noqa: BLE001
+                self._reply(400, {"error": str(e)})
+                return
+            self._route(post_routes, dynamic_post, body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path.split("?")[0] == "/metrics":
+                data = monitoring.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            self._route(get_routes, dynamic_get, {})
+
+        def handle_one_request(self):
+            # a client that times out / resets mid-write is business as
+            # usual at the serving boundary, not a stack trace
+            try:
+                super().handle_one_request()
+            except (ConnectionResetError, BrokenPipeError):
+                self.close_connection = True
+
+        def log_message(self, *args):
+            pass
+
+    class Server(ThreadingHTTPServer):
+        # socketserver's default listen backlog of 5 resets connections
+        # under bursty client fleets before admission control ever sees
+        # them; backpressure must come from 429s, not TCP RSTs
+        request_queue_size = 128
+        daemon_threads = True
+
+    httpd = Server((host, port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, thread
+
+
+# Back-compat alias (pre-gateway name, used by external callers of the old
+# deeplearning4j_tpu.serving module).
+_serve_json = serve_json

@@ -1,0 +1,61 @@
+"""Warmup / AOT precompile at registered batch-shape buckets.
+
+The first request against a cold model pays the XLA compile (hundreds of ms
+to minutes) on the request path. ParallelInference already pads partial
+batches to power-of-two buckets, so the set of batch shapes a model will
+ever see is known *at load time*: the pow2 ladder clamped to ``batch_limit``
+(plus ``batch_limit`` itself for non-pow2 limits). ``warmup_model`` runs the
+model once per bucket on zeros at load, populating the per-shape jit cache
+(and the persistent XLA compilation cache when configured) so no request
+ever stalls behind a compile — the serving analog of PyGraph's
+remove-the-launch-gap result: the device never waits on the host.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu import monitoring
+
+
+def pow2_buckets(batch_limit: int) -> Tuple[int, ...]:
+    """The dispatchable batch sizes under pad-to-bucket batching: powers of
+    two clamped to the limit, plus the limit itself (non-pow2 limits)."""
+    if batch_limit < 1:
+        raise ValueError("batch_limit must be >= 1")
+    return tuple(sorted({min(1 << i, batch_limit)
+                         for i in range(batch_limit.bit_length() + 1)}))
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest registered bucket >= n (the shape a size-n batch pads to);
+    the largest bucket when n exceeds them all (the dispatcher splits)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+def warmup_model(model, example_shape: Sequence[int],
+                 buckets: Sequence[int],
+                 dtype=np.float32,
+                 labels: Optional[Tuple[str, str]] = None) -> Dict[int, float]:
+    """Run ``model.output`` once per bucket on zeros of
+    ``(bucket, *example_shape)``; returns {bucket: seconds}. ``labels``:
+    optional (model, version) pair for the warmup-duration histogram."""
+    timings: Dict[int, float] = {}
+    shape = tuple(int(d) for d in example_shape)
+    for b in sorted(set(int(b) for b in buckets)):
+        x = np.zeros((b,) + shape, dtype)
+        t0 = time.perf_counter()
+        np.asarray(model.output(x))  # np.asarray blocks until device done
+        timings[b] = time.perf_counter() - t0
+    mon = monitoring.serving_monitor()
+    if mon is not None and labels is not None:
+        for dt in timings.values():
+            mon.warmup_seconds.labels(model=labels[0],
+                                      version=labels[1]).observe(dt)
+    return timings

@@ -41,6 +41,23 @@ SETUP = {
     "getting_started.md": """
 import numpy as np
 """,
+    "serving.md": """
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+def _mk_model(seed):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.1))
+            .list()
+            .layer(DenseLayer(n_out=4, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    return MultiLayerNetwork(conf).init()
+
+model, stable, candidate = _mk_model(0), _mk_model(1), _mk_model(2)
+""",
     "datavec.md": """
 import numpy as np
 from deeplearning4j_tpu.datavec import CSVRecordReader, Schema

@@ -784,6 +784,33 @@ class TestNativeTextFront:
         # is the route; vocab content is the witness)
         assert "café" in w2v.vocab.index
 
+    def test_late_non_ascii_detected_by_sampling(self, tmp_path):
+        # ADVICE r5: _ascii_sample only read the first 1 MiB, so a corpus
+        # whose non-ASCII content starts later was still routed natively
+        # (silently divergent vocab). Sampling now covers head/middle/tail.
+        p = tmp_path / "corpus.txt"
+        ascii_mb = ("the cat sat on the mat " * 64 + "\n").encode()
+        with open(p, "wb") as f:
+            for _ in range(1600):          # ~2.3 MiB of pure-ASCII head
+                f.write(ascii_mb)
+            f.write("the café sat on the mat\n".encode("utf-8") * 50)
+        assert not Word2Vec._ascii_sample(str(p))
+        # middle-only non-ASCII is caught too
+        p2 = tmp_path / "corpus2.txt"
+        with open(p2, "wb") as f:
+            for _ in range(800):
+                f.write(ascii_mb)
+            f.write("naïve déjà vu\n".encode("utf-8") * 50)
+            for _ in range(800):
+                f.write(ascii_mb)
+        assert not Word2Vec._ascii_sample(str(p2))
+        # pure ASCII of the same size still qualifies
+        p3 = tmp_path / "corpus3.txt"
+        with open(p3, "wb") as f:
+            for _ in range(1600):
+                f.write(ascii_mb)
+        assert Word2Vec._ascii_sample(str(p3))
+
     def test_closed_stream_raises_instead_of_segfaulting(self, tmp_path):
         from deeplearning4j_tpu.nlp.native_text import NativeSkipGramStream
 

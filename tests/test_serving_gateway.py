@@ -1,0 +1,515 @@
+"""Serving gateway tests (PR 2): registry + canary routing, admission
+control under concurrent overload (429 backpressure, 504 deadlines),
+warmup/AOT precompile coverage, graceful drain, zero-drop hot reload,
+admin routes, and the legacy ModelServer's timeout mapping.
+
+Most tests drive the real HTTP path but serve STUB models (plain-Python
+``output()``) so the tier-1 suite never waits on XLA compiles; the
+end-to-end case with a real MultiLayerNetwork warming every bucket is
+marked slow.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.serving import (ModelServer, ServingGateway,
+                                        bucket_for, pow2_buckets)
+
+
+class StubModel:
+    """Plain-Python stand-in for a network: affine transform with optional
+    service delay; records every input shape it executes (each distinct
+    shape is where a real model would pay an XLA compile)."""
+
+    def __init__(self, scale=1.0, delay=0.0):
+        self.scale = scale
+        self.delay = delay
+        self.shapes = set()
+        self._lock = threading.Lock()
+
+    def output(self, x):
+        x = np.asarray(x)
+        with self._lock:
+            self.shapes.add(x.shape)
+        if self.delay:
+            time.sleep(self.delay)
+        return x * self.scale
+
+
+def _post(base, path, payload, timeout=30):
+    """POST helper returning (status, body-dict, headers)."""
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _get(base, path, timeout=10):
+    try:
+        r = urllib.request.urlopen(base + path, timeout=timeout)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def metrics_on():
+    monitoring.reset()
+    monitoring.enable()
+    yield
+    monitoring.reset()
+
+
+class TestBuckets:
+    def test_pow2_buckets(self):
+        assert pow2_buckets(32) == (1, 2, 4, 8, 16, 32)
+        assert pow2_buckets(24) == (1, 2, 4, 8, 16, 24)
+        assert pow2_buckets(1) == (1,)
+
+    def test_bucket_for(self):
+        bs = pow2_buckets(32)
+        assert bucket_for(1, bs) == 1
+        assert bucket_for(3, bs) == 4
+        assert bucket_for(32, bs) == 32
+        assert bucket_for(100, bs) == 32  # dispatcher splits above the top
+
+
+class TestGatewayBasics:
+    def test_lifecycle_routing_and_canary(self, metrics_on):
+        gw = ServingGateway(port=0, batch_limit=8, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            assert _get(base, "/healthz")[0] == 200
+            assert _get(base, "/readyz")[0] == 503          # nothing loaded
+            assert _post(base, "/v1/nope/predict",
+                         {"inputs": [[1.0]]})[0] == 404
+
+            v1, v2 = StubModel(1.0), StubModel(2.0)
+            gw.register_model("m", "v1", v1, warmup_shape=(4,))
+            assert _get(base, "/readyz")[0] == 200
+            gw.register_model("m", "v2", v2, warmup_shape=(4,), weight=0.0)
+            gw.set_split("m", {"v1": 0.9, "v2": 0.1})
+
+            # 90/10 canary: both versions take traffic, outputs match the
+            # version each response claims served it
+            seen = {"v1": 0, "v2": 0}
+            for _ in range(60):
+                code, body, _ = _post(base, "/v1/m/predict",
+                                      {"inputs": [[1.0, 2.0, 3.0, 4.0]]})
+                assert code == 200
+                scale = {"v1": 1.0, "v2": 2.0}[body["version"]]
+                np.testing.assert_allclose(
+                    body["outputs"][0], [1.0 * scale, 2.0 * scale,
+                                         3.0 * scale, 4.0 * scale])
+                seen[body["version"]] += 1
+            assert seen["v1"] > seen["v2"] > 0
+
+            # registry listing carries versions + split
+            code, listing = _get(base, "/models")
+            models = json.loads(listing)["models"]
+            assert set(models["m"]["versions"]) == {"v1", "v2"}
+            assert models["m"]["split"] == {"v1": 0.9, "v2": 0.1}
+
+            # per-model metrics visible on the gateway's own scrape
+            scrape = _get(base, "/metrics")[1]
+            assert ('dl4j_serving_model_request_seconds_bucket{model="m"'
+                    in scrape)
+            assert 'dl4j_serving_model_loaded{model="m",version="v1"} 1' in scrape
+        finally:
+            gw.stop()
+
+    def test_warmup_covers_every_request_shape(self, metrics_on):
+        """The AOT property: after load-time warmup at the pow2 buckets, no
+        request presents a NEW batch shape to the model — i.e. a real model
+        would never compile on the request path."""
+        gw = ServingGateway(port=0, batch_limit=8, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            m = StubModel()
+            gw.register_model("m", "v1", m, warmup_shape=(4,))
+            warmed = set(m.shapes)
+            assert warmed == {(b, 4) for b in pow2_buckets(8)}
+            for n in (1, 2, 3, 5, 8):   # incl. non-pow2 request sizes
+                code, _, _ = _post(base, "/v1/m/predict",
+                                   {"inputs": [[0.0] * 4] * n})
+                assert code == 200
+            assert m.shapes == warmed, (
+                f"request path saw unwarmed shapes: {m.shapes - warmed}")
+            # warmup durations were recorded per bucket
+            reg = monitoring.registry()
+            fam = reg.get("dl4j_serving_warmup_seconds")
+            assert fam.labels(model="m", version="v1").count == len(warmed)
+        finally:
+            gw.stop()
+
+
+class TestAdmissionControl:
+    def test_overload_sheds_429_never_hangs(self, metrics_on):
+        """Bounded queue + slow model + concurrent burst: the overflow is
+        rejected 429 with Retry-After, the rest are served, and the whole
+        burst resolves promptly (no unbounded pile-up)."""
+        gw = ServingGateway(port=0, batch_limit=1, max_queue=2, seed=0,
+                            queue_timeout_s=0.001).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("slow", "v1", StubModel(delay=0.1),
+                              warmup_shape=(2,))
+            results, lock = [], threading.Lock()
+
+            def fire():
+                code, _, headers = _post(base, "/v1/slow/predict",
+                                         {"inputs": [[1.0, 2.0]]})
+                with lock:
+                    results.append((code, headers.get("Retry-After")))
+
+            threads = [threading.Thread(target=fire) for _ in range(16)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            elapsed = time.monotonic() - t0
+            codes = [c for c, _ in results]
+            assert len(codes) == 16
+            assert codes.count(429) >= 1, codes
+            assert codes.count(200) >= 1, codes
+            assert set(codes) <= {200, 429}, codes
+            assert all(ra is not None for c, ra in results if c == 429)
+            # 16 requests x 100 ms service through a 2-deep queue would be
+            # ~1.6 s if everything piled up; shedding keeps it well under
+            assert elapsed < 10.0
+            shed = monitoring.registry().get("dl4j_serving_shed_total")
+            assert shed.labels(model="slow",
+                               reason="queue_full").value == codes.count(429)
+        finally:
+            gw.stop()
+
+    def test_deadline_maps_to_504(self, metrics_on):
+        gw = ServingGateway(port=0, batch_limit=1, seed=0,
+                            queue_timeout_s=0.001).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("slow", "v1", StubModel(delay=0.2),
+                              warmup_shape=(2,))
+            code, body, _ = _post(base, "/v1/slow/predict",
+                                  {"inputs": [[1.0, 2.0]], "timeout_ms": 30})
+            assert code == 504
+            assert "deadline" in body["error"]
+            # within budget -> 200
+            code, _, _ = _post(base, "/v1/slow/predict",
+                               {"inputs": [[1.0, 2.0]], "timeout_ms": 5000})
+            assert code == 200
+        finally:
+            gw.stop()
+
+    def test_model_error_maps_to_500(self, metrics_on):
+        class Broken:
+            def output(self, x):
+                raise RuntimeError("boom")
+
+        gw = ServingGateway(port=0, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("b", "v1", Broken(), warmup=False)
+            code, body, _ = _post(base, "/v1/b/predict",
+                                  {"inputs": [[1.0]]})
+            assert code == 500 and "boom" in body["error"]
+        finally:
+            gw.stop()
+
+
+class TestLifecycle:
+    def test_drain_completes_in_flight(self, metrics_on):
+        """stop() while a request is in flight: that request completes 200;
+        requests arriving after the drain starts get 503."""
+        gw = ServingGateway(port=0, batch_limit=1, seed=0,
+                            queue_timeout_s=0.001).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        gw.register_model("slow", "v1", StubModel(delay=0.3),
+                          warmup_shape=(2,))
+        results, lock = {}, threading.Lock()
+
+        def fire(tag):
+            code, body, _ = _post(base, "/v1/slow/predict",
+                                  {"inputs": [[1.0, 2.0]]})
+            with lock:
+                results[tag] = code
+
+        inflight = threading.Thread(target=fire, args=("inflight",))
+        inflight.start()
+        time.sleep(0.1)                      # in the model's sleep now
+        stopper = threading.Thread(target=gw.stop)
+        stopper.start()
+        time.sleep(0.05)                     # drain flag is up
+        late = threading.Thread(target=fire, args=("late",))
+        late.start()
+        inflight.join(timeout=30)
+        late.join(timeout=30)
+        stopper.join(timeout=30)
+        assert results["inflight"] == 200
+        assert results["late"] == 503
+
+    def test_hot_reload_zero_drops(self, metrics_on):
+        """Hammer one model from worker threads while it is hot-reloaded:
+        every response is a 200 from exactly one of the two instances, and
+        traffic after the swap is served by the replacement."""
+        gw = ServingGateway(port=0, batch_limit=4, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("m", "v1", StubModel(1.0), warmup_shape=(2,))
+            stop = threading.Event()
+            outcomes, lock = [], threading.Lock()
+
+            def hammer():
+                while not stop.is_set():
+                    code, body, _ = _post(base, "/v1/m/predict",
+                                          {"inputs": [[1.0, 2.0]]})
+                    with lock:
+                        outcomes.append((code, body.get("outputs")))
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.15)
+            # hot swap v1 -> same version id, new instance (scale 2)
+            gw.register_model("m", "v1", StubModel(2.0), warmup_shape=(2,))
+            time.sleep(0.15)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert outcomes
+            codes = {c for c, _ in outcomes}
+            assert codes == {200}, f"dropped requests: {codes}"
+            for _, outs in outcomes:
+                assert outs[0] in ([1.0, 2.0], [2.0, 4.0])
+            # the final responses come from the replacement
+            assert outcomes[-1][1][0] == [2.0, 4.0]
+        finally:
+            gw.stop()
+
+
+class TestAdminRoutes:
+    def test_load_split_unload_from_disk(self, tmp_path, metrics_on):
+        """The full admin lifecycle over HTTP with a REAL network: save two
+        versions with write_model, POST /models/load + /split, predict
+        against both, unload back to 404."""
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+        from deeplearning4j_tpu.util.serialization import write_model
+
+        def make(seed):
+            conf = (NeuralNetConfiguration.builder().seed(seed)
+                    .updater(Sgd(lr=0.1)).list()
+                    .layer(DenseLayer(n_out=8, activation="relu"))
+                    .layer(OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        m1, m2 = make(1), make(2)
+        p1, p2 = str(tmp_path / "v1.zip"), str(tmp_path / "v2.zip")
+        write_model(m1, p1)
+        write_model(m2, p2)
+
+        gw = ServingGateway(port=0, batch_limit=4, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            # warmup=False keeps this tier-1 fast (2 models x 4 buckets of
+            # real XLA compile otherwise); the warmed path is tested above
+            # with stubs and below in the slow end-to-end case
+            for ver, path in (("v1", p1), ("v2", p2)):
+                code, body, _ = _post(base, "/models/load",
+                                      {"name": "mlp", "version": ver,
+                                       "path": path, "warmup": False})
+                assert code == 200, body
+            code, body, _ = _post(base, "/models/split",
+                                  {"name": "mlp",
+                                   "split": {"v1": 0.5, "v2": 0.5}})
+            assert code == 200 and body["split"] == {"v1": 0.5, "v2": 0.5}
+
+            xs = np.linspace(-1, 1, 8).reshape(2, 4).astype(np.float32)
+            seen = set()
+            for _ in range(20):
+                code, body, _ = _post(base, "/v1/mlp/predict",
+                                      {"inputs": xs.tolist()})
+                assert code == 200
+                seen.add(body["version"])
+                ref = {"v1": m1, "v2": m2}[body["version"]]
+                np.testing.assert_allclose(
+                    np.asarray(body["outputs"]), np.asarray(ref.output(xs)),
+                    rtol=1e-4, atol=1e-6)
+            assert seen == {"v1", "v2"}
+
+            code, body, _ = _post(base, "/models/unload", {"name": "mlp"})
+            assert code == 200
+            assert _post(base, "/v1/mlp/predict",
+                         {"inputs": xs.tolist()})[0] == 404
+            assert _get(base, "/readyz")[0] == 503
+        finally:
+            gw.stop()
+
+    def test_bad_admin_requests(self, metrics_on):
+        gw = ServingGateway(port=0, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            assert _post(base, "/models/load", {"name": "x"})[0] == 400
+            assert _post(base, "/models/unload", {"name": "x"})[0] == 404
+            assert _post(base, "/models/split",
+                         {"name": "x", "split": {"v": 1}})[0] == 404
+        finally:
+            gw.stop()
+
+    def test_admin_disabled(self, metrics_on):
+        gw = ServingGateway(port=0, seed=0, admin=False).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("m", "v1", StubModel(), warmup=False)
+            assert _post(base, "/models/unload", {"name": "m"})[0] == 404
+            assert _post(base, "/v1/m/predict",
+                         {"inputs": [[1.0]]})[0] == 200
+        finally:
+            gw.stop()
+
+
+class TestModelServerTimeout:
+    def test_queue_timeout_maps_to_504_and_cancels_siblings(self):
+        """The legacy server's fix: a result timeout is a 504 (was a
+        generic 400), and the shared deadline lets the worker shed the
+        sibling submits instead of orphaning their queues."""
+        slow = StubModel(delay=0.5)
+        server = ModelServer(slow, port=0, batch_limit=1,
+                             queue_timeout=0.1)
+        server._pi.queue_timeout_s = 0.001
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            code, body, _ = _post(base, "/predict",
+                                  {"inputs": [[1.0], [2.0], [3.0]]})
+            assert code == 504
+            assert "timed out" in body["error"]
+            # the worker sheds the expired siblings: its backlog returns to
+            # empty instead of grinding through dead requests
+            deadline = time.monotonic() + 10
+            while server._pi.backlog() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server._pi.backlog() == 0
+        finally:
+            server.stop()
+
+    def test_healthy_predict_still_200(self):
+        server = ModelServer(StubModel(3.0), port=0, batch_limit=4).start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            code, body, _ = _post(base, "/predict",
+                                  {"inputs": [[1.0, 2.0]]})
+            assert code == 200
+            np.testing.assert_allclose(body["outputs"], [[3.0, 6.0]])
+        finally:
+            server.stop()
+
+
+class TestPrefetchLeak:
+    """Satellite regression: AsyncPrefetchIterator's producer thread must
+    terminate when the consumer abandons the generator mid-epoch (it used
+    to block forever on the bounded queue.put, leaking the thread and its
+    pinned batches)."""
+
+    def _iterator(self, n_batches=64):
+        from deeplearning4j_tpu.datasets.iterators import (
+            ArrayDataSetIterator, AsyncPrefetchIterator)
+
+        x = np.zeros((n_batches * 2, 4), np.float32)
+        y = np.zeros((n_batches * 2, 2), np.float32)
+        inner = ArrayDataSetIterator(x, y, batch_size=2)
+        return AsyncPrefetchIterator(inner, queue_size=1, device_put=False)
+
+    def _assert_worker_exits(self, it):
+        deadline = time.monotonic() + 5
+        while it._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not it._thread.is_alive(), "prefetch thread leaked"
+
+    def test_abandoned_generator_stops_producer(self):
+        it = self._iterator()
+        gen = iter(it)
+        next(gen)                  # producer running, queue full behind us
+        gen.close()                # consumer walks away mid-epoch
+        self._assert_worker_exits(it)
+
+    def test_explicit_close(self):
+        it = self._iterator()
+        gen = iter(it)
+        next(gen)
+        it.close()
+        self._assert_worker_exits(it)
+
+    def test_full_epoch_still_complete(self):
+        it = self._iterator(n_batches=8)
+        assert sum(1 for _ in it) == 8
+        assert sum(1 for _ in it) == 8     # reusable across epochs
+
+
+@pytest.mark.slow
+class TestGatewayEndToEndSlow:
+    def test_real_model_warmup_and_serve(self, metrics_on):
+        """Compile-heavy end-to-end: a real MultiLayerNetwork warmed at
+        every bucket, then served — the first request's latency excludes
+        compile (bounded by a multiple of the steady-state latency)."""
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.updaters import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(lr=0.1)).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        model = MultiLayerNetwork(conf).init()
+
+        gw = ServingGateway(port=0, batch_limit=8, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            mv = gw.register_model("mlp", "v1", model, warmup_shape=(4,))
+            assert sorted(mv.warmup_timings) == [1, 2, 4, 8]
+            xs = np.linspace(-1, 1, 12).reshape(3, 4).astype(np.float32)
+            t0 = time.perf_counter()
+            code, body, _ = _post(base, "/v1/mlp/predict",
+                                  {"inputs": xs.tolist()})
+            first = time.perf_counter() - t0
+            assert code == 200
+            np.testing.assert_allclose(
+                np.asarray(body["outputs"]), np.asarray(model.output(xs)),
+                rtol=1e-4, atol=1e-6)
+            # steady-state reference
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                _post(base, "/v1/mlp/predict", {"inputs": xs.tolist()})
+                times.append(time.perf_counter() - t0)
+            steady = float(np.median(times))
+            # a cold XLA compile is ~100x a warm dense forward; 20x slack
+            # keeps this robust to scheduler noise while still catching a
+            # compile riding the first request
+            assert first < max(20 * steady, 1.0), (
+                f"first request {first:.3f}s vs steady {steady:.4f}s — "
+                "compile on the request path?")
+        finally:
+            gw.stop()
