@@ -42,6 +42,10 @@ class Environment:
     LSTM_SCAN_BWD = "DL4J_TPU_LSTM_SCAN_BWD"
     # Same escape hatch for the fused GRU backward.
     GRU_SCAN_BWD = "DL4J_TPU_GRU_SCAN_BWD"
+    # Import-graph optimizer (modelimport/optimizer.py): constant folding,
+    # layout-op elimination, attention fusion over TF/ONNX/Keras imports.
+    # Default ON; DL4J_TPU_IMPORT_OPT=0 restores the raw parsed graph.
+    IMPORT_OPT = "DL4J_TPU_IMPORT_OPT"
 
     def __init__(self) -> None:
         self.reload()
@@ -55,6 +59,7 @@ class Environment:
         self.monitoring = _flag(self.MONITORING)
         self.lstm_scan_bwd = _flag(self.LSTM_SCAN_BWD)
         self.gru_scan_bwd = _flag(self.GRU_SCAN_BWD)
+        self.import_opt = _flag(self.IMPORT_OPT, True)
 
 
 env = Environment()

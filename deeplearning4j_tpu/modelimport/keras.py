@@ -404,8 +404,16 @@ class KerasModelImport:
     # ------------------------------------------------------------- topology
     @staticmethod
     def _build(cfg: dict) -> MultiLayerNetwork:
+        from deeplearning4j_tpu.modelimport import optimizer as graph_opt
+
         cls = cfg["class_name"]
         layers_cfg = cfg["config"]["layers"]
+        opt_stats = None
+        if graph_opt.import_opt_enabled():
+            # layer-level application of the import-graph optimizer: drop
+            # exporter no-ops (rate-0 dropout, linear Activation layers)
+            layers_cfg, opt_stats = graph_opt.prune_keras_layers(
+                layers_cfg, graph=False)
         if cls == "Functional":
             # linear-chain functional models only (round 1)
             pass
@@ -445,6 +453,7 @@ class KerasModelImport:
         conf = b.set_input_type(itype).build()
         model = MultiLayerNetwork(conf).init()
         model._keras_names = keras_names
+        model.import_opt_stats = opt_stats
         return model
 
     # ---------------------------------------------------- functional -> DAG
@@ -456,6 +465,7 @@ class KerasModelImport:
         org.deeplearning4j.nn.modelimport.keras — inbound_nodes become
         vertex edges; Add/Multiply/Average/Concatenate merge layers map onto
         ElementWiseVertex/MergeVertex."""
+        from deeplearning4j_tpu.modelimport import optimizer as graph_opt
         from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex, MergeVertex
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
@@ -464,8 +474,13 @@ class KerasModelImport:
         input_types = {}
         keras_names = []
         outputs = [o[0] for o in cfg["config"]["output_layers"]]
+        layers_cfg = cfg["config"]["layers"]
+        opt_stats = None
+        if graph_opt.import_opt_enabled():
+            layers_cfg, opt_stats = graph_opt.prune_keras_layers(
+                layers_cfg, graph=True, outputs=outputs)
 
-        for lc in cfg["config"]["layers"]:
+        for lc in layers_cfg:
             kcls = lc["class_name"]
             kcfg = lc["config"]
             name = lc.get("name") or kcfg["name"]
@@ -511,6 +526,7 @@ class KerasModelImport:
         conf = gb.set_input_types(**input_types).set_outputs(*outputs).build()
         model = ComputationGraph(conf).init()
         model._keras_names = keras_names
+        model.import_opt_stats = opt_stats
         return model
 
     @staticmethod

@@ -879,11 +879,22 @@ def _gmp(node, xs):
 
 class OnnxImportedGraph:
     def __init__(self, nodes: List[OnnxNode], initializers: Dict[str, np.ndarray],
-                 inputs: List[str], outputs: List[str]):
+                 inputs: List[str], outputs: List[str],
+                 input_info: Optional[Dict[str, tuple]] = None):
         self.nodes = nodes
         self.initializers = initializers
         self.graph_inputs = [i for i in inputs if i not in initializers]
         self.graph_outputs = outputs
+        # (np dtype | None, static shape tuple | None) per declared input —
+        # seeds the import-graph optimizer's shape-inference env
+        self.input_info = dict(input_info or {})
+        # import-graph optimizer state: values folded to constants at
+        # import time (never trainable), removed-value aliases, and the
+        # per-rule rewrite counts
+        self._folded: Dict[str, np.ndarray] = {}
+        self._aliases: Dict[str, str] = {}
+        self._removed: set = set()
+        self.import_opt_stats: Optional[Dict[str, int]] = None
 
     def output(self, feeds: Dict[str, np.ndarray],
                outputs: Optional[List[str]] = None):
@@ -891,6 +902,7 @@ class OnnxImportedGraph:
         # reads (axes, shapes, pads) stay concrete — jnp.asarray inside a jit
         # trace returns a tracer on current JAX and would break them
         acts: Dict[str, object] = dict(self.initializers)
+        acts.update(self._folded)
         for k, v in feeds.items():
             acts[k] = jnp.asarray(v)
         return self._run(acts, outputs)
@@ -915,8 +927,18 @@ class OnnxImportedGraph:
                     acts[o] = v
             else:
                 acts[outs[0]] = y
+        from deeplearning4j_tpu.modelimport.optimizer import resolve_alias
+
         names = outputs or self.graph_outputs
-        res = [acts[n] for n in names]
+        res = []
+        for n in names:
+            key = resolve_alias(self._aliases, n)
+            if key not in acts and n in self._removed:
+                raise KeyError(
+                    f"{n!r} was removed by the import-graph optimizer; "
+                    f"re-import with DL4J_TPU_IMPORT_OPT=0 (or "
+                    f"optimize=False) to probe it")
+            res.append(acts[key])
         return res[0] if len(res) == 1 else res
 
     def as_function(self, outputs: Optional[List[str]] = None) -> Callable:
@@ -935,6 +957,8 @@ class OnnxImportedGraph:
         fold."""
         known: Dict[str, object] = {k: v for k, v in self.initializers.items()
                                     if k not in exclude}
+        known.update({k: v for k, v in self._folded.items()
+                      if k not in exclude})
         folded: Dict[str, object] = {}
         avail = set(known)
         for node in self.nodes:
@@ -1038,6 +1062,7 @@ class OnnxImportedGraph:
         # weight set on every eager call
         consts: Dict[str, object] = {k: _cast_const(v)
                                      for k, v in self.initializers.items()}
+        consts.update({k: _cast_const(v) for k, v in self._folded.items()})
         consts.update({k: _cast_const(v) for k, v in baked.items()})
 
         def fn(params, feeds):
@@ -1056,11 +1081,35 @@ class OnnxImportedGraph:
         return fn, params
 
 
+def _parse_value_info(buf: bytes):
+    """ValueInfoProto -> (name, (np dtype | None, static shape | None)).
+    TypeProto.tensor_type(1): elem_type=1, shape=2 (TensorShapeProto.dim=1,
+    each dim_value=1 / dim_param=2 — symbolic dims become None)."""
+    f = parse_message(buf)
+    name = f[1][0].decode()
+    dtype, shape = None, None
+    if 2 in f:
+        tp = parse_message(f[2][0])
+        if 1 in tp:
+            tt = parse_message(tp[1][0])
+            if 1 in tt:
+                dtype = _ONNX_DTYPES.get(tt[1][0])
+                dtype = np.dtype(dtype) if dtype is not None else None
+            if 2 in tt:
+                dims = []
+                for db in parse_message(tt[2][0]).get(1, []):
+                    d = parse_message(db)
+                    dims.append(int(d[1][0]) if 1 in d else None)
+                shape = tuple(dims)
+    return name, (dtype, shape)
+
+
 class OnnxModelImport:
     """importModel entry point (the ONNX analog of KerasModelImport)."""
 
     @staticmethod
-    def import_model(path_or_bytes) -> OnnxImportedGraph:
+    def import_model(path_or_bytes,
+                     optimize: Optional[bool] = None) -> OnnxImportedGraph:
         if isinstance(path_or_bytes, (bytes, bytearray)):
             buf = bytes(path_or_bytes)
         else:
@@ -1070,9 +1119,12 @@ class OnnxModelImport:
         graph = parse_message(model[7][0])    # GraphProto
         nodes = [OnnxNode(b) for b in graph.get(1, [])]
         inits = dict(_parse_onnx_tensor(b) for b in graph.get(5, []))
-        def _value_names(bufs):
-            return [parse_message(b)[1][0].decode() for b in bufs]
+        in_infos = dict(_parse_value_info(b) for b in graph.get(11, []))
+        outputs = [parse_message(b)[1][0].decode() for b in graph.get(12, [])]
+        imp = OnnxImportedGraph(nodes, inits, list(in_infos), outputs,
+                                input_info=in_infos)
+        from deeplearning4j_tpu.modelimport import optimizer as graph_opt
 
-        inputs = _value_names(graph.get(11, []))
-        outputs = _value_names(graph.get(12, []))
-        return OnnxImportedGraph(nodes, inits, inputs, outputs)
+        if optimize if optimize is not None else graph_opt.import_opt_enabled():
+            graph_opt.optimize_onnx(imp)
+        return imp

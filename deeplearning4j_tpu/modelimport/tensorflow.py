@@ -992,6 +992,10 @@ class TFImportedGraph:
                  functions: Optional[Dict[str, "TFFunction"]] = None):
         self.nodes = {n.name: n for n in nodes}
         self.order = [n.name for n in nodes]  # GraphDefs are topo-sorted
+        # the default output is the LAST PARSED node — pinned here so
+        # graph rewrites (which may remove or reorder trailing nodes,
+        # leaving aliases/folded values behind) can't change it
+        self.default_output = self.order[-1] if self.order else None
         self.functions = functions or {}
         self.constants: Dict[str, np.ndarray] = {}
         self.placeholders: List[str] = []
@@ -1000,6 +1004,12 @@ class TFImportedGraph:
         # constants), and the chosen SignatureDef {inputs, outputs}
         self.variables: Dict[str, np.ndarray] = {}
         self.signature: Optional[Dict[str, Dict[str, str]]] = None
+        # import-graph optimizer state: import-time folded constants (never
+        # trainable), removed-value aliases, and per-rule rewrite counts
+        self.folded: Dict[str, np.ndarray] = {}
+        self.aliases: Dict[str, str] = {}
+        self.removed: set = set()
+        self.import_opt_stats: Optional[Dict[str, int]] = None
         for n in nodes:
             if n.op == "Const":
                 self.constants[n.name] = n.attr("value").tensor
@@ -1016,6 +1026,18 @@ class TFImportedGraph:
         "name:out_arg:N" (function-body style) — against produced values."""
         parts = ref.split(":")
         name = parts[0]
+        if name not in acts:
+            alias = self.aliases.get(name)
+            if alias is not None:
+                v = self._resolve(acts, alias, op_of)
+                if len(parts) > 1 and isinstance(v, tuple):
+                    v = v[int(parts[-1])]
+                return v
+            if name in self.removed:
+                raise KeyError(
+                    f"{name!r} was removed by the import-graph optimizer; "
+                    f"re-import with DL4J_TPU_IMPORT_OPT=0 (or "
+                    f"optimize=False) to probe it")
         v = acts[name]
         if not isinstance(v, tuple):
             return v
@@ -1143,7 +1165,7 @@ class TFImportedGraph:
                           if self.nodes[n].op != "Const"], acts)
         op_of = {k: n.op for k, n in self.nodes.items()}
         res = [self._resolve(acts, o, op_of)
-               for o in (outputs or [self.order[-1]])]
+               for o in (outputs or [self.default_output or self.order[-1]])]
         return res[0] if len(res) == 1 else res
 
     def output(self, feeds: Dict[str, np.ndarray],
@@ -1156,6 +1178,7 @@ class TFImportedGraph:
             # bounds) stay concrete — jnp.asarray here would return a tracer
             # under jit on current JAX, breaking int(np.asarray(...)) reads
             acts[name] = const
+        acts.update(self.folded)
         for name, val in self.variables.items():
             acts[name] = val
         for name, val in feeds.items():
@@ -1213,6 +1236,7 @@ class TFImportedGraph:
 
         def fn(params, feeds):
             acts: Dict[str, object] = dict(self.constants)
+            acts.update(self.folded)
             acts.update(self.variables)
             acts.update(params)
             for name, val in feeds.items():
@@ -1237,17 +1261,23 @@ class TFImportedGraph:
 
         def const_val(name):
             ref = self._ref(name)
-            if ref not in self.constants:
-                raise NotImplementedError(
-                    f"to_samediff: node input '{ref}' must be a Const")
-            return np.asarray(self.constants[ref])
+            if ref in self.constants:
+                return np.asarray(self.constants[ref])
+            if ref in self.folded:
+                return np.asarray(self.folded[ref])
+            raise NotImplementedError(
+                f"to_samediff: node input '{ref}' must be a Const")
 
         for name in self.order:
             node = self.nodes[name]
             ins = [i for i in node.inputs if not i.startswith("^")]
 
             def x(i):
-                return handles[self._ref(ins[i])]
+                ref = self._ref(ins[i])
+                if ref not in handles and ref in self.folded:
+                    # import-time folded value: materialize as a constant
+                    handles[ref] = sd.constant(self.folded[ref], name=ref)
+                return handles[ref]
 
             if node.op == "Const":
                 handles[name] = sd.constant(self.constants[name], name=name)
@@ -1441,17 +1471,26 @@ class TFGraphMapper:
     """importGraph entry point (TFGraphMapper.importGraph analog)."""
 
     @staticmethod
-    def import_graph(path_or_bytes) -> TFImportedGraph:
+    def import_graph(path_or_bytes,
+                     optimize: Optional[bool] = None) -> TFImportedGraph:
         if isinstance(path_or_bytes, (bytes, bytearray)):
             buf = bytes(path_or_bytes)
         else:
             with open(path_or_bytes, "rb") as f:
                 buf = f.read()
         nodes, functions = parse_graph(buf)
-        return TFImportedGraph(nodes, functions)
+        g = TFImportedGraph(nodes, functions)
+        from deeplearning4j_tpu.modelimport import optimizer as graph_opt
+
+        if optimize if optimize is not None else graph_opt.import_opt_enabled():
+            # no DCE roots: a bare frozen GraphDef's outputs are chosen by
+            # the caller, so every node stays probe-able
+            graph_opt.optimize_tf(g)
+        return g
 
     @staticmethod
-    def import_saved_model(path, signature: str = "serving_default"
+    def import_saved_model(path, signature: str = "serving_default",
+                           optimize: Optional[bool] = None
                            ) -> TFImportedGraph:
         """Import a SavedModel DIRECTORY (saved_model.pb + variables/).
 
@@ -1522,4 +1561,10 @@ class TFGraphMapper:
             raise NotImplementedError(
                 f"no checkpoint value for variable nodes {missing} "
                 f"(checkpoint has {sorted(ckpt)[:8]}...){og_hint}")
+        from deeplearning4j_tpu.modelimport import optimizer as graph_opt
+
+        if optimize if optimize is not None else graph_opt.import_opt_enabled():
+            roots = (list(sig["outputs"].values())
+                     if sig and sig["outputs"] else None)
+            graph_opt.optimize_tf(g, roots=roots)
         return g

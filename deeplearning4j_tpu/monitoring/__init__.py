@@ -45,6 +45,7 @@ _fit_mon = None
 _serving_mon = None
 _localsgd_mon = None
 _ckpt_mon = None
+_import_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -71,11 +72,12 @@ def reset() -> None:
     Test isolation hook; instrument bundles are re-created lazily against
     the new registry."""
     global _REGISTRY, _tracer, _enabled
-    global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon
+    global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
+    _import_mon = None
 
 
 def metrics_text() -> str:
@@ -249,6 +251,20 @@ class _CheckpointMonitor:
             "dl4j_checkpoint_saves_total", "Checkpoint saves issued")
 
 
+class _ImportMonitor:
+    """Import-graph optimizer instruments: per-rule rewrite counts per
+    frontend (modelimport/optimizer.py), so the effect of the pass on each
+    imported model is observable in the same registry the serving and fit
+    tiers scrape."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.rewrites = reg.counter(
+            "dl4j_import_opt_rewrites_total",
+            "Import-graph optimizer rewrites applied, by frontend and rule",
+            labels=("frontend", "rule"))
+
+
 def _bundle(cache_name: str, cls):
     if not _enabled:
         return None
@@ -277,6 +293,10 @@ def checkpoint_monitor() -> Optional[_CheckpointMonitor]:
     return _bundle("_ckpt_mon", _CheckpointMonitor)
 
 
+def import_monitor() -> Optional[_ImportMonitor]:
+    return _bundle("_import_mon", _ImportMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 
 __all__ = [
@@ -285,5 +305,5 @@ __all__ = [
     "registry", "enabled", "enable", "disable", "reset", "metrics_text",
     "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
     "fit_monitor", "serving_monitor", "localsgd_monitor",
-    "checkpoint_monitor",
+    "checkpoint_monitor", "import_monitor",
 ]

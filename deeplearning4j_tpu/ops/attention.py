@@ -20,14 +20,22 @@ from deeplearning4j_tpu.ops.registry import op, register_op
 
 
 @register_op("dot_product_attention")
-def dot_product_attention(q, k, v, *, mask=None, scale=None, causal=False):
+def dot_product_attention(q, k, v, *, mask=None, bias=None, scale=None,
+                          causal=False):
     """softmax(q k^T / sqrt(d)) v.
 
     mask: broadcastable to [B, N, Tq, Tk], 1=keep 0=drop (additive -inf applied).
+    bias: broadcastable to [B, N, Tq, Tk], ADDED to the scaled logits before
+    the softmax — the exporter-style additive attention mask / relative
+    position bias form the import-graph optimizer's fused-attention rewrite
+    produces. The Pallas flash kernel structurally rejects bias-carrying
+    calls (registry routes them here).
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
     logits = jnp.einsum("bntd,bnsd->bnts", q, k) * scale
+    if bias is not None:
+        logits = logits + bias
     neg = jnp.finfo(logits.dtype).min
     if causal:
         tq, tk = logits.shape[-2], logits.shape[-1]

@@ -484,8 +484,8 @@ def _is_key_padding(mask, q, k):
             and shp[0] in (1, q.shape[0]))
 
 
-def flash_attention(q, k, v, *, mask=None, scale=None, causal=False,
-                    block_q: int = 512, block_k: int = 1024):
+def flash_attention(q, k, v, *, mask=None, bias=None, scale=None,
+                    causal=False, block_q: int = 512, block_k: int = 1024):
     """Public entry: same signature as the XLA dot_product_attention.
 
     Default tiles are the v5e sweet spot measured at T=8192 (fwd 512x1024,
@@ -496,6 +496,11 @@ def flash_attention(q, k, v, *, mask=None, scale=None, causal=False,
     ``mask`` accepts key-padding masks ([B, Tk] or the layer tier's
     [B, 1, 1, Tk]); general [Tq, Tk]-varying masks are structurally
     rejected (registry routes them to the XLA lowering)."""
+    if bias is not None:
+        raise ValueError(
+            "flash_attention does not support additive logit biases; the "
+            "registry's requires predicate routes bias calls to the XLA "
+            "lowering")
     km = _as_key_padding(mask, q.shape[0], k.shape[-2])
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -506,8 +511,10 @@ def _flash_requires(q, k, v, *, mask=None, scale=None, causal=False, **kw):
     # structural: masks are supported iff they reduce to a key-padding mask
     # over Tk; the kernel's causal mask is start-aligned (query i sees keys
     # <= i) which only matches the XLA lowering's end-aligned tril when
-    # Tq == Tk
-    return (_is_key_padding(mask, q, k)
+    # Tq == Tk. Additive logit biases (the import optimizer's fused
+    # exporter-mask form) are not expressible in the kernel — XLA lowering.
+    return (kw.get("bias") is None
+            and _is_key_padding(mask, q, k)
             and (not causal or q.shape[-2] == k.shape[-2]))
 
 

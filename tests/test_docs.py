@@ -114,6 +114,15 @@ _enc = TransformerEncoderLayer(d_model=128, n_heads=H, causal=True)
 params, _ = _enc.init(jax.random.key(0),
                       InputType.recurrent(128, _T))
 """,
+    "import_optimizer.md": """
+import shutil
+import numpy as np
+shutil.copy(r"{fx}/bert_tiny.onnx", "model.onnx")
+_g = np.load(r"{fx}/bert_golden.npz")
+ids, mask = _g["ids"], _g["mask"]
+from deeplearning4j_tpu import monitoring as _mon
+_mon.reset()
+""",
     "model_import.md": """
 import shutil
 import numpy as np

@@ -1,0 +1,570 @@
+"""Import-graph optimizer tests (modelimport/optimizer.py).
+
+Three tiers:
+- per-rule unit tests on hand-built ONNX/TF graphs (the same dependency-
+  free protobuf writers the frontend tests use);
+- end-to-end equivalence over the committed golden fixtures: pass ON vs
+  OFF must be numerically identical at the golden tolerances, with the
+  attention subgraph provably routed through get_op("dot_product_attention")
+  (call-witness) on the BERT fixture;
+- the escape-hatch CI guard: DL4J_TPU_IMPORT_OPT=0 (optimize=False)
+  restores the EXACT raw parsed graph — node count + topology hash.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport import optimizer as graph_opt
+from deeplearning4j_tpu.modelimport.onnx import OnnxModelImport
+from deeplearning4j_tpu.modelimport.tensorflow import TFGraphMapper
+
+from test_onnximport import onnx_attr, onnx_model, onnx_node, onnx_tensor
+from test_tfimport import _attr, _len_field, _shape_proto, graph_def, node
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _onnx(nodes, inits, inputs, outputs, optimize=True):
+    return OnnxModelImport.import_model(
+        onnx_model(nodes, inits, inputs, outputs), optimize=optimize)
+
+
+def _shape_attr(key, dims):
+    """NodeDef attr carrying a TensorShapeProto (AttrValue field 7) — the
+    Placeholder shape the optimizer's shape-inference env seeds from."""
+    val = _len_field(7, _shape_proto(dims))
+    entry = _len_field(1, key.encode()) + _len_field(2, val)
+    return _len_field(5, entry)
+
+
+# ----------------------------------------------------------- per-rule units
+
+
+class TestOnnxRules:
+    def test_identity_chain_eliminated_and_probeable(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Identity", ["x"], ["a"]),
+             onnx_node("Identity", ["a"], ["b"]),
+             onnx_node("Relu", ["b"], ["y"])],
+            [], ["x"], ["y"])
+        assert imp.import_opt_stats["identity"] == 2
+        assert [n.op for n in imp.nodes] == ["Relu"]
+        np.testing.assert_allclose(np.asarray(imp.output({"x": x})),
+                                   np.maximum(x, 0))
+        # the eliminated names stay probe-able through the alias map
+        np.testing.assert_allclose(
+            np.asarray(imp.output({"x": x}, outputs=["a"])), x)
+
+    def test_constant_folding_keeps_float_params(self, rng):
+        w = rng.normal(size=(3, 3)).astype(np.float32)
+        two = np.asarray([2], np.int64)
+        imp = _onnx(
+            [onnx_node("Add", ["c1", "c1"], ["c2"]),     # 2+2: foldable
+             onnx_node("Mul", ["w", "w"], ["w2"]),       # param: NOT folded
+             onnx_node("Relu", ["x"], ["y"])],
+            [onnx_tensor("c1", two), onnx_tensor("w", w)],
+            ["x"], ["y", "c2", "w2"])
+        assert "c2" in imp._folded
+        np.testing.assert_array_equal(imp._folded["c2"], two + two)
+        assert any(n.op == "Mul" for n in imp.nodes), \
+            "float rank>=1 initializer (potential trainable) was folded"
+
+    def test_transpose_pair_cancels(self, rng):
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Transpose", ["x"], ["t1"],
+                       onnx_attr("perm", ints=[2, 0, 1])),
+             onnx_node("Transpose", ["t1"], ["t2"],
+                       onnx_attr("perm", ints=[1, 2, 0])),
+             onnx_node("Relu", ["t2"], ["y"])],
+            [], ["x"], ["y"])
+        assert imp.import_opt_stats["transpose_pairs"] >= 1
+        assert not any(n.op == "Transpose" for n in imp.nodes)
+        np.testing.assert_allclose(np.asarray(imp.output({"x": x})),
+                                   np.maximum(x, 0))
+
+    def test_transpose_pair_composes(self, rng):
+        x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Transpose", ["x"], ["t1"],
+                       onnx_attr("perm", ints=[1, 0, 2])),
+             onnx_node("Transpose", ["t1"], ["t2"],
+                       onnx_attr("perm", ints=[0, 2, 1])),
+             onnx_node("Relu", ["t2"], ["y"])],
+            [], ["x"], ["y"])
+        # one synthetic transpose with the composed perm replaces the pair
+        kinds = [n.op for n in imp.nodes]
+        assert kinds.count(graph_opt.SYNTH_TRANSPOSE_OP) == 1
+        assert "Transpose" not in kinds
+        want = np.maximum(np.transpose(np.transpose(x, (1, 0, 2)),
+                                       (0, 2, 1)), 0)
+        np.testing.assert_allclose(np.asarray(imp.output({"x": x})), want)
+
+    def test_reshape_chain_collapses(self, rng):
+        x = rng.normal(size=(2, 6)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Reshape", ["x", "s1"], ["r1"]),
+             onnx_node("Reshape", ["r1", "s2"], ["r2"]),
+             onnx_node("Relu", ["r2"], ["y"])],
+            [onnx_tensor("s1", np.asarray([3, 4], np.int64)),
+             onnx_tensor("s2", np.asarray([4, 3], np.int64))],
+            ["x"], ["y"])
+        assert imp.import_opt_stats["reshape_chains"] >= 1
+        reshapes = [n for n in imp.nodes if n.op == "Reshape"]
+        assert len(reshapes) == 1 and reshapes[0].inputs[0] == "x"
+        np.testing.assert_allclose(np.asarray(imp.output({"x": x})),
+                                   np.maximum(x.reshape(4, 3), 0))
+
+    def test_unsqueeze_squeeze_cancels(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Unsqueeze", ["x", "ax"], ["u"]),
+             onnx_node("Squeeze", ["u", "ax"], ["s"]),
+             onnx_node("Relu", ["s"], ["y"])],
+            [onnx_tensor("ax", np.asarray([1], np.int64))],
+            ["x"], ["y"])
+        assert imp.import_opt_stats["expand_squeeze"] >= 1
+        assert not any(n.op in ("Unsqueeze", "Squeeze") for n in imp.nodes)
+        np.testing.assert_allclose(np.asarray(imp.output({"x": x})),
+                                   np.maximum(x, 0))
+
+    def test_noop_cast_eliminated_float_cast_kept(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Greater", ["x", "x"], ["g"]),        # bool
+             onnx_node("Cast", ["g"], ["c1"], onnx_attr("to", i=9)),  # noop
+             onnx_node("Cast", ["c1"], ["c2"], onnx_attr("to", i=1)),
+             # f32 -> f32: a no-op TODAY, but compute_dtype overrides make
+             # it bf16-producing under mixed precision — must be kept
+             onnx_node("Cast", ["c2"], ["c3"], onnx_attr("to", i=1))],
+            [], ["x"], ["c3"])
+        assert imp.import_opt_stats["noop_cast"] == 1
+        casts = [n for n in imp.nodes if n.op == "Cast"]
+        assert len(casts) == 2
+        np.testing.assert_allclose(np.asarray(imp.output({"x": x})),
+                                   np.zeros_like(x))
+
+    def test_dce_drops_unreachable(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        imp = _onnx(
+            [onnx_node("Relu", ["x"], ["y"]),
+             onnx_node("Sigmoid", ["x"], ["dead1"]),
+             onnx_node("Tanh", ["dead1"], ["dead2"])],
+            [], ["x"], ["y"])
+        assert imp.import_opt_stats["dce"] == 2
+        assert [n.op for n in imp.nodes] == ["Relu"]
+        with pytest.raises(KeyError, match="DL4J_TPU_IMPORT_OPT"):
+            imp.output({"x": x}, outputs=["dead2"])
+
+
+def _tf_bert_block(rng, with_shape=True):
+    """A rank-4 composed-attention TF graph (the torch/TF exporter shape:
+    matmul -> scalar scale -> mask add -> softmax -> matmul)."""
+    B, H, T, D = 2, 2, 4, 8
+    q = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    scale = np.asarray(1.0 / np.sqrt(D), np.float32)  # rank-0: peelable
+    bias = np.zeros((B, 1, 1, T), np.float32)
+    bias[:, :, :, -1] = -1e9
+    ph_attrs = {}
+    if with_shape:
+        ph_attrs["shape"] = _shape_attr("shape", (B, H, T, D))
+    g = graph_def(
+        node("q", "Placeholder", **ph_attrs),
+        node("k", "Placeholder", **ph_attrs),
+        node("v", "Placeholder", **ph_attrs),
+        node("bias", "Const", value=_attr("value", t=bias)),
+        node("scale", "Const", value=_attr("value", t=scale)),
+        node("scores0", "BatchMatMulV2", ["q", "k"],
+             adj_y=_attr("adj_y", b=True)),
+        node("scores", "Mul", ["scores0", "scale"]),
+        node("masked", "AddV2", ["scores", "bias"]),
+        node("probs", "Softmax", ["masked"]),
+        node("ctx", "BatchMatMulV2", ["probs", "v"]),
+    )
+    return g, q, scale, bias
+
+
+class TestTFRules:
+    def test_identity_and_alias(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        g = graph_def(
+            node("x", "Placeholder"),
+            node("i1", "Identity", ["x"]),
+            node("i2", "StopGradient", ["i1"]),
+            node("y", "Relu", ["i2"]),
+        )
+        imp = TFGraphMapper.import_graph(g)
+        assert imp.import_opt_stats["identity"] == 2
+        assert "i1" not in imp.nodes and "i2" not in imp.nodes
+        np.testing.assert_allclose(
+            np.asarray(imp.output({"x": x}, ["y"])), np.maximum(x, 0))
+        # probing the eliminated name still works via the alias map
+        np.testing.assert_allclose(
+            np.asarray(imp.output({"x": x}, ["i2"])), x)
+
+    def test_fuse_attention_rank4(self, rng):
+        g, q, scale, bias = _tf_bert_block(rng)
+        imp = TFGraphMapper.import_graph(g)
+        assert imp.import_opt_stats["fuse_attention"] == 1
+        assert any(n.op == graph_opt.FUSED_ATTENTION_OP
+                   for n in imp.nodes.values())
+        raw = TFGraphMapper.import_graph(g, optimize=False)
+        feeds = {"q": q, "k": q + 0.1, "v": q - 0.1}
+        got = np.asarray(imp.output(feeds, ["ctx"]))
+        want = np.asarray(raw.output(feeds, ["ctx"]))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_fusion_skipped_without_static_rank(self, rng):
+        # no Placeholder shapes -> rank unknown -> conservative skip
+        g, q, scale, bias = _tf_bert_block(rng, with_shape=False)
+        imp = TFGraphMapper.import_graph(g)
+        assert imp.import_opt_stats["fuse_attention"] == 0
+
+    def test_no_dce_without_known_outputs(self, rng):
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        g = graph_def(
+            node("x", "Placeholder"),
+            node("branch", "Sigmoid", ["x"]),
+            node("y", "Relu", ["x"]),
+        )
+        imp = TFGraphMapper.import_graph(g)
+        assert imp.import_opt_stats["dce"] == 0
+        np.testing.assert_allclose(
+            np.asarray(imp.output({"x": x}, ["branch"])),
+            1.0 / (1.0 + np.exp(-x)), rtol=1e-6)
+
+
+# ------------------------------------------------- golden on/off equivalence
+
+
+class TestGoldenEquivalence:
+    """Every committed golden fixture: optimized output == raw output."""
+
+    def test_onnx_bert(self):
+        g = np.load(_fx("bert_golden.npz"))
+        feeds = {"input_ids": g["ids"], "attention_mask": g["mask"]}
+        outs = ["last_hidden_state", "pooler_output"]
+        on = OnnxModelImport.import_model(_fx("bert_tiny.onnx"),
+                                          optimize=True)
+        off = OnnxModelImport.import_model(_fx("bert_tiny.onnx"),
+                                           optimize=False)
+        for a, b in zip(on.output(feeds, outs), off.output(feeds, outs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        # and both still match the recorded torch outputs at the golden
+        # tolerances
+        lh, po = on.output(feeds, outs)
+        np.testing.assert_allclose(np.asarray(lh), g["last_hidden"],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(po), g["pooler"],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_tf_small_cnn_probes(self):
+        g = np.load(_fx("tf_small_cnn_golden.npz"))
+        probe = [str(p) for p in g["probe"]]
+        on = TFGraphMapper.import_graph(_fx("tf_small_cnn.pb"),
+                                        optimize=True)
+        off = TFGraphMapper.import_graph(_fx("tf_small_cnn.pb"),
+                                         optimize=False)
+        feeds = {str(g["placeholder"]): g["x"]}
+        for a, b in zip(on.output(feeds, probe), off.output(feeds, probe)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_tf_control_flow(self):
+        g = np.load(_fx("ctrl_golden.npz"))
+        on = TFGraphMapper.import_graph(_fx("ctrl_flow_v2.pb"),
+                                        optimize=True)
+        off = TFGraphMapper.import_graph(_fx("ctrl_flow_v2.pb"),
+                                         optimize=False)
+        ph = on.placeholders[0]
+        for sign in (1, -1):
+            a = np.asarray(on.output({ph: sign * np.abs(g["x"])}))
+            b = np.asarray(off.output({ph: sign * np.abs(g["x"])}))
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+    def test_saved_model(self):
+        g = np.load(_fx("saved_model_cnn_golden.npz"))
+        on = TFGraphMapper.import_saved_model(_fx("saved_model_cnn"),
+                                              optimize=True)
+        off = TFGraphMapper.import_saved_model(_fx("saved_model_cnn"),
+                                               optimize=False)
+        a = np.asarray(on.run_signature({"input": g["x"]})["output"])
+        b = np.asarray(off.run_signature({"input": g["x"]})["output"])
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+    def test_bert_as_trainable_on_off(self):
+        """Import-then-train keeps the IDENTICAL parameter set and the
+        same outputs with the pass on or off."""
+        import jax
+
+        g = np.load(_fx("bert_golden.npz"))
+        feeds = {"input_ids": g["ids"], "attention_mask": g["mask"]}
+        on = OnnxModelImport.import_model(_fx("bert_tiny.onnx"),
+                                          optimize=True)
+        off = OnnxModelImport.import_model(_fx("bert_tiny.onnx"),
+                                           optimize=False)
+        fn_on, p_on = on.as_trainable(outputs=["pooler_output"])
+        fn_off, p_off = off.as_trainable(outputs=["pooler_output"])
+        assert set(p_on) == set(p_off)
+        a = jax.jit(fn_on)(p_on, feeds)
+        b = jax.jit(fn_off)(p_off, feeds)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+        ga = jax.grad(lambda p: fn_on(p, feeds).sum())(p_on)
+        gb = jax.grad(lambda p: fn_off(p, feeds).sum())(p_off)
+        for k in ga:
+            np.testing.assert_allclose(np.asarray(ga[k]), np.asarray(gb[k]),
+                                       rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+class TestAttentionPathWitness:
+    def test_bert_routes_through_registry_attention(self):
+        """The fused nodes exist AND get_op("dot_product_attention") is
+        actually invoked when the optimized import executes — the path
+        assertion behind the bench's attention_path_imported field."""
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        g = np.load(_fx("bert_golden.npz"))
+        imp = OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+        fused = [n for n in imp.nodes
+                 if n.op == graph_opt.FUSED_ATTENTION_OP]
+        assert len(fused) == 2          # one per encoder layer
+        assert imp.import_opt_stats["fuse_attention"] == 2
+        # each fused node carries q/k/v (+ the additive mask) and the
+        # peeled 1/sqrt(head_dim) scale (the fixture's geometry: 4 heads,
+        # head_dim 16 -> 0.25, recovered from the exporter's folded
+        # Shape -> Slice -> Sqrt -> Div chain)
+        for n in fused:
+            assert len(n.inputs) == 4
+            assert abs(n.scale - 0.25) < 1e-6
+        opx = get_op("dot_product_attention")
+        calls = []
+        impl = opx.xla
+        orig = impl.fn
+
+        def spy(*a, **kw):
+            calls.append(tuple(np.shape(x) for x in a[:3]))
+            return orig(*a, **kw)
+
+        impl.fn = spy
+        try:
+            imp.output({"input_ids": g["ids"],
+                        "attention_mask": g["mask"]},
+                       outputs=["pooler_output"])
+        finally:
+            impl.fn = orig
+        assert len(calls) == 2
+        # shape witness: [B, heads, T, head_dim] per encoder layer
+        assert all(shp == ((2, 4, 16, 16),) * 3 for shp in calls)
+
+    def test_bias_routes_to_xla_lowering(self):
+        """The flash kernel structurally rejects additive biases: selection
+        with bias must land on the XLA lowering even under FORCE_PALLAS."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.common.env import env
+        from deeplearning4j_tpu.ops.registry import get_op
+
+        q = jnp.zeros((1, 1, 2048, 64), jnp.float32)
+        bias = jnp.zeros((1, 1, 1, 2048), jnp.float32)
+        opx = get_op("dot_product_attention")
+        assert opx.select(q, q, q).platform == "pallas"
+        assert opx.select(q, q, q, bias=bias).platform == "xla"
+        old = env.force_pallas
+        env.force_pallas = True
+        try:
+            assert opx.select(q, q, q, bias=bias).platform == "xla"
+        finally:
+            env.force_pallas = old
+
+    def test_fused_bias_numerics(self, rng):
+        """bias-carrying dot_product_attention == softmax(qk*scale+bias)v."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.attention import dot_product_attention
+
+        B, H, T, D = 2, 2, 5, 4
+        q = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        k = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        v = rng.normal(size=(B, H, T, D)).astype(np.float32)
+        bias = np.where(rng.random((B, 1, 1, T)) < 0.3, -1e9, 0.0
+                        ).astype(np.float32)
+        got = np.asarray(dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            bias=jnp.asarray(bias), scale=0.5))
+        logits = (q @ np.swapaxes(k, -1, -2)) * 0.5 + bias
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        want = (e / e.sum(-1, keepdims=True)) @ v
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------- escape-hatch CI guard
+
+
+class TestEscapeHatch:
+    """DL4J_TPU_IMPORT_OPT=0 must restore the exact pre-optimizer graph
+    (node count + topology hash) — the hatch cannot silently rot."""
+
+    def test_env_flag_off_is_raw_parse_onnx(self, monkeypatch):
+        from deeplearning4j_tpu.common.env import env
+
+        explicit = OnnxModelImport.import_model(_fx("bert_tiny.onnx"),
+                                                optimize=False)
+        monkeypatch.setattr(env, "import_opt", False)
+        via_env = OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+        assert graph_opt.graph_signature(via_env) == \
+            graph_opt.graph_signature(explicit)
+        assert via_env.import_opt_stats is None
+        assert not via_env._folded and not via_env._aliases
+        # and the optimizer genuinely changes the graph when on
+        monkeypatch.setattr(env, "import_opt", True)
+        on = OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+        assert graph_opt.graph_signature(on) != \
+            graph_opt.graph_signature(explicit)
+        assert graph_opt.graph_signature(on)[0] < \
+            graph_opt.graph_signature(explicit)[0]
+
+    def test_env_flag_off_is_raw_parse_tf(self, monkeypatch):
+        from deeplearning4j_tpu.common.env import env
+
+        explicit = TFGraphMapper.import_graph(_fx("tf_small_cnn.pb"),
+                                              optimize=False)
+        monkeypatch.setattr(env, "import_opt", False)
+        via_env = TFGraphMapper.import_graph(_fx("tf_small_cnn.pb"))
+        assert graph_opt.graph_signature(via_env) == \
+            graph_opt.graph_signature(explicit)
+        assert not via_env.folded and not via_env.aliases
+
+    def test_env_var_reaches_the_flag(self, monkeypatch):
+        from deeplearning4j_tpu.common.env import Environment
+
+        monkeypatch.setenv("DL4J_TPU_IMPORT_OPT", "0")
+        assert Environment().import_opt is False
+        monkeypatch.delenv("DL4J_TPU_IMPORT_OPT")
+        assert Environment().import_opt is True
+
+
+# -------------------------------------------------------------- monitoring
+
+
+class TestRewriteCounters:
+    def test_counters_flow_through_registry(self):
+        from deeplearning4j_tpu import monitoring
+
+        monitoring.reset()
+        monitoring.enable()
+        try:
+            OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+            fam = monitoring.registry().get(
+                "dl4j_import_opt_rewrites_total")
+            assert fam is not None
+            vals = {key: child.value for key, child in fam.children()}
+            assert vals[("onnx", "fuse_attention")] == 2
+            assert vals[("onnx", "identity")] >= 20
+            assert "dl4j_import_opt_rewrites_total" in \
+                monitoring.metrics_text()
+        finally:
+            monitoring.reset()
+
+
+# --------------------------------------------------- compiled-cost criterion
+
+
+@pytest.mark.slow
+class TestCompiledCost:
+    def test_bert_import_bytes_within_budget_of_native(self):
+        """The PR's acceptance criterion, pinned: the optimized imported
+        BERT fine-tune step compiles to <= 1.2x the native twin's
+        bytes_accessed (r05 measured 1.62x pre-optimizer). Compile-heavy,
+        hence slow; the bench `bert_import` lane reports the same ratio
+        (plus the on/off A-B) on the real chip."""
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from deeplearning4j_tpu.optimize.updaters import Adam, get_updater
+        from deeplearning4j_tpu.zoo import Bert
+
+        BO, BI, T, V, C = 8, 2, 16, 500, 2
+        B = BO * BI
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, (B, T)).astype(np.int32)
+        y = jnp.asarray(np.eye(C, dtype=np.float32)[
+            rng.integers(0, C, B)])
+        feeds = {"input_ids": jnp.asarray(ids).reshape(BO, BI, T),
+                 "attention_mask": jnp.ones((BO, BI, T), jnp.int32)}
+        imp = OnnxModelImport.import_model(_fx("bert_tiny.onnx"))
+        _, _, cost_on = bench._bert_import_step(imp, y, feeds, B, 64)
+        ci = cost_on()
+        twin = Bert(vocab_size=V, max_len=T, d_model=64, n_layers=2,
+                    n_heads=2, d_ff=128, num_classes=C, dropout=0.0,
+                    lr=2e-5, dtype="bf16", seed=1).init()
+        twin.conf.max_grad_norm = 0.0
+        twin._updaters = [get_updater(Adam(lr=2e-5)) for _ in twin.layers]
+        twin.opt_state = [u.init_state(p)
+                          for u, p in zip(twin._updaters, twin.params)]
+        tstep = twin._jit_cache.get("train") or twin._make_train_step()
+        ct = bench._cost(tstep.lower(
+            twin.params, twin.state, twin.opt_state,
+            jnp.asarray(0, jnp.int32), jnp.asarray(ids), y,
+            jax.random.key(1), None).compile())
+        assert ci.get("bytes_accessed") and ct.get("bytes_accessed")
+        ratio = ci["bytes_accessed"] / ct["bytes_accessed"]
+        assert ratio <= 1.2, f"bytes_accessed imported/native = {ratio:.3f}"
+
+
+# ------------------------------------------------------------- keras layer
+
+
+class TestKerasLayerPass:
+    def test_noop_layers_pruned(self, tmp_path, rng):
+        from test_kerasimport import _write_keras_h5
+
+        W1 = rng.normal(size=(6, 8)).astype(np.float32)
+        b1 = rng.normal(size=(8,)).astype(np.float32)
+        W2 = rng.normal(size=(8, 3)).astype(np.float32)
+        b2 = rng.normal(size=(3,)).astype(np.float32)
+        layers = [
+            {"class_name": "Dense",
+             "config": {"name": "dense", "units": 8, "activation": "relu",
+                        "use_bias": True, "batch_input_shape": [None, 6]}},
+            {"class_name": "Dropout",
+             "config": {"name": "drop", "rate": 0.0}},
+            {"class_name": "Activation",
+             "config": {"name": "act", "activation": "linear"}},
+            {"class_name": "Dropout",          # rate > 0: must survive
+             "config": {"name": "drop2", "rate": 0.5}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "units": 3,
+                        "activation": "softmax", "use_bias": True}},
+        ]
+        path = _write_keras_h5(tmp_path / "m.h5", layers, {
+            "dense": [("kernel:0", W1), ("bias:0", b1)],
+            "dense_1": [("kernel:0", W2), ("bias:0", b2)],
+        })
+        from deeplearning4j_tpu.modelimport import KerasModelImport
+
+        model = KerasModelImport.import_model(str(path))
+        assert model.import_opt_stats == {"noop_dropout": 1,
+                                          "identity_layer": 1}
+        # rate-0.5 dropout kept; the two no-ops gone
+        from deeplearning4j_tpu.nn.layers import DropoutLayer
+
+        drops = [l for l in model.conf.layers
+                 if isinstance(l, DropoutLayer)]
+        assert len(drops) == 1 and drops[0].rate == 0.5
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        out = np.asarray(model.output(x))
+        h = np.maximum(x @ W1 + b1, 0)
+        logits = h @ W2 + b2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-4, atol=1e-6)
