@@ -46,6 +46,13 @@ class Environment:
     # layout-op elimination, attention fusion over TF/ONNX/Keras imports.
     # Default ON; DL4J_TPU_IMPORT_OPT=0 restores the raw parsed graph.
     IMPORT_OPT = "DL4J_TPU_IMPORT_OPT"
+    # Deterministic fault injection (deeplearning4j_tpu.faults): spec
+    # grammar "cls:rate[@cond]" plus its seed and simulated straggler
+    # delay. Parsed by faults.configure()/reset() (not cached here);
+    # unset = no plan installed = zero-overhead injection points.
+    FAULTS = "DL4J_TPU_FAULTS"
+    FAULTS_SEED = "DL4J_TPU_FAULTS_SEED"
+    FAULTS_DELAY_S = "DL4J_TPU_FAULTS_DELAY_S"
 
     def __init__(self) -> None:
         self.reload()

@@ -20,14 +20,47 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 
 
 class DataSetIterator:
-    """Iterable+resettable; subclasses implement _produce()."""
+    """Iterable+resettable; subclasses implement _produce().
+
+    Batch reads are a fault-injection point (``data_io``) and run under a
+    shared RetryPolicy: a transient storage error on one batch is retried
+    with backoff instead of killing the epoch (the reference's
+    RecordReader retry story, owned here by the iterator base so every
+    subclass inherits it). With no fault plan installed this is a single
+    None check per batch — the zero-overhead contract."""
 
     def __init__(self, batch_size: int):
         self.batch = batch_size
         self.preprocessor = None
+        self._retry = None          # built lazily on first injected fault
+
+    def _read_batch(self, it):
+        """One guarded pull: the injected ``data_io`` fault fires BEFORE
+        the generator advances, so a retry re-attempts the SAME batch."""
+        from deeplearning4j_tpu import faults
+
+        plan = faults.active()
+        if plan is None:
+            return next(it)
+        if self._retry is None:
+            self._retry = faults.RetryPolicy(
+                max_attempts=4, base_delay_s=0.01, max_delay_s=0.2,
+                deadline_s=10.0)
+
+        def pull():
+            if plan.fires("data_io"):
+                raise faults.DataReadFault("injected dataset read failure")
+            return next(it)
+
+        return self._retry.call(pull, component="data")
 
     def __iter__(self) -> Iterator[DataSet]:
-        for ds in self._produce():
+        it = iter(self._produce())
+        while True:
+            try:
+                ds = self._read_batch(it)
+            except StopIteration:
+                return
             if self.preprocessor is not None:
                 self.preprocessor.transform(ds)
             yield ds

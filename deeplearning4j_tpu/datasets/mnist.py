@@ -45,12 +45,27 @@ _GLYPHS = {
 
 
 def _read_idx(path: str) -> np.ndarray:
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rb") as f:
-        magic, = struct.unpack(">I", f.read(4))
-        ndim = magic & 0xFF
-        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+    """IDX file read under the shared RetryPolicy: real corpora live on
+    network filesystems where a transient EIO on one read is routine —
+    retrying with backoff beats failing the whole import (``data_io``
+    injects exactly that error)."""
+    from deeplearning4j_tpu import faults
+
+    def read():
+        plan = faults.active()
+        if plan is not None and plan.fires("data_io"):
+            raise faults.DataReadFault(f"injected read failure for {path}")
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, = struct.unpack(">I", f.read(4))
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+    if faults.active() is None:
+        return read()
+    return faults.RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                              max_delay_s=0.2).call(read, component="data")
 
 
 def _find_idx(train: bool):

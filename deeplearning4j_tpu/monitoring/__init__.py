@@ -46,6 +46,7 @@ _serving_mon = None
 _localsgd_mon = None
 _ckpt_mon = None
 _import_mon = None
+_recovery_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -73,11 +74,12 @@ def reset() -> None:
     the new registry."""
     global _REGISTRY, _tracer, _enabled
     global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
+    global _recovery_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
-    _import_mon = None
+    _import_mon = _recovery_mon = None
 
 
 def metrics_text() -> str:
@@ -251,6 +253,29 @@ class _CheckpointMonitor:
             "dl4j_checkpoint_saves_total", "Checkpoint saves issued")
 
 
+class _RecoveryMonitor:
+    """Fault-tolerance instruments: every recovery action any subsystem
+    takes (checkpoint fallback, retry-then-succeed, straggler drop, worker
+    restart) lands in ``dl4j_recovery_total{component,outcome}``; retry
+    attempts and injected faults (deeplearning4j_tpu.faults) ride along so
+    an injected-fault run is fully reconstructable from /metrics."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.recovery_total = reg.counter(
+            "dl4j_recovery_total",
+            "Recovery actions taken, by component and outcome",
+            labels=("component", "outcome"))
+        self.retry_attempts = reg.counter(
+            "dl4j_retry_attempts_total",
+            "Retry attempts made by RetryPolicy call sites",
+            labels=("component",))
+        self.faults_injected = reg.counter(
+            "dl4j_faults_injected_total",
+            "Faults injected by the deeplearning4j_tpu.faults plan",
+            labels=("cls",))
+
+
 class _ImportMonitor:
     """Import-graph optimizer instruments: per-rule rewrite counts per
     frontend (modelimport/optimizer.py), so the effect of the pass on each
@@ -297,6 +322,10 @@ def import_monitor() -> Optional[_ImportMonitor]:
     return _bundle("_import_mon", _ImportMonitor)
 
 
+def recovery_monitor() -> Optional[_RecoveryMonitor]:
+    return _bundle("_recovery_mon", _RecoveryMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 
 __all__ = [
@@ -305,5 +334,5 @@ __all__ = [
     "registry", "enabled", "enable", "disable", "reset", "metrics_text",
     "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
     "fit_monitor", "serving_monitor", "localsgd_monitor",
-    "checkpoint_monitor", "import_monitor",
+    "checkpoint_monitor", "import_monitor", "recovery_monitor",
 ]

@@ -495,6 +495,8 @@ class ComputationGraph:
         if labels is not None:
             for _ in range(epochs):
                 self.fit_batch((data, labels))
+            for lst in self.listeners:
+                lst.on_fit_end(self)
             return self
         for _ in range(epochs):
             for lst in self.listeners:
@@ -509,6 +511,8 @@ class ComputationGraph:
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
+        for lst in self.listeners:
+            lst.on_fit_end(self)
         return self
 
     # ------------------------------------------------------------------ eval

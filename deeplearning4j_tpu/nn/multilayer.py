@@ -438,6 +438,8 @@ class MultiLayerNetwork:
         if labels is not None:
             for _ in range(epochs):
                 self.fit_batch((data, labels))
+            for lst in self.listeners:
+                lst.on_fit_end(self)
             return self
         for _ in range(epochs):
             for lst in self.listeners:
@@ -452,6 +454,8 @@ class MultiLayerNetwork:
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
+        for lst in self.listeners:
+            lst.on_fit_end(self)
         return self
 
     # -------------------------------------------------------------- pretrain

@@ -24,6 +24,11 @@ class TrainingListener:
     def on_epoch_end(self, model, epoch: int):
         pass
 
+    def on_fit_end(self, model):
+        """Called once when a fit() call completes (all epochs done) —
+        the hook checkpoint/flush listeners use to capture final state."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     """Print score every N iterations (ScoreIterationListener)."""

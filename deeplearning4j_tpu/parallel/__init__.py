@@ -22,7 +22,7 @@ from deeplearning4j_tpu.parallel.expert import (
     init_moe_params, moe_param_specs, place_moe_params, switch_moe,
 )
 from deeplearning4j_tpu.parallel.spark import (
-    ParameterAveragingTrainingMaster, SharedTrainingMaster,
+    ParameterAveragingTrainingMaster, RoundSupervisor, SharedTrainingMaster,
     SparkComputationGraph, SparkDl4jMultiLayer,
 )
 from deeplearning4j_tpu.parallel.distributed import (
@@ -43,6 +43,7 @@ __all__ = ["DeviceMesh", "multi_slice_mesh", "ParameterAveragingTrainer", "Paral
            "switch_moe", "FaultTolerantTrainer", "initialize_distributed",
            "SparkDl4jMultiLayer", "SparkComputationGraph",
            "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
+           "RoundSupervisor",
            "ring_attention", "ring_attention_zigzag", "ulysses_attention",
            "sequence_parallel_encoder", "zigzag_shard", "zigzag_unshard",
            "EncodedGradientTrainer", "threshold_encode", "message_density"]

@@ -66,7 +66,25 @@ class ParallelWrapper:
             parts = (x, y, mask, label_mask)
         batch = self.mesh.shard_batch(parts)
         with self.mesh.mesh:
-            return self.model.fit_batch(batch)
+            loss = self.model.fit_batch(batch)
+        if self._lockstep():
+            # multi-process CPU (Gloo): fit_batch's float(loss) does NOT
+            # wait for the gradient/param psum (loss is computed pre-
+            # update), so the all-reduce is still in flight when the host
+            # moves on. Any later host-initiated collective (orbax save
+            # barriers, broadcast_one_to_all) then interleaves with it on
+            # the same Gloo pair and aborts the transport. Blocking on the
+            # updated params serializes the rounds; TPU/GPU transports
+            # don't need it and skip this branch.
+            jax.block_until_ready((self.model.params, self.model.opt_state,
+                                   self.model.state))
+        return loss
+
+    def _lockstep(self) -> bool:
+        if not hasattr(self, "_lockstep_cached"):
+            self._lockstep_cached = (jax.process_count() > 1
+                                     and jax.default_backend() == "cpu")
+        return self._lockstep_cached
 
     def fit(self, data, epochs: int = 1):
         from deeplearning4j_tpu.datasets.iterators import AsyncPrefetchIterator

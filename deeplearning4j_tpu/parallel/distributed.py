@@ -12,7 +12,9 @@ the reference implements with Spark retries).
 
 from __future__ import annotations
 
+import json
 import os
+import warnings
 from typing import Callable, Optional
 
 import jax
@@ -20,14 +22,23 @@ import jax
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> dict:
+                           process_id: Optional[int] = None,
+                           retry=None) -> dict:
     """jax.distributed.initialize wrapper, env-driven like the reference's
     VoidParameterServer config (COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID;
     on TPU pods the args auto-detect from the metadata server).
 
+    The coordinator connect runs under a :class:`faults.RetryPolicy`
+    (``retry`` overrides the default 5-attempt exponential backoff): a
+    coordinator that is still coming up after a pod relaunch refuses a few
+    connects before accepting — one-shot init turned that into a dead job.
+    Fault class ``coord_connect`` injects exactly that refusal.
+
     Returns a summary dict; a no-op single-process summary when no
     coordinator is configured.
     """
+    from deeplearning4j_tpu import faults
+
     coordinator_address = coordinator_address or os.environ.get(
         "COORDINATOR_ADDRESS")
     if num_processes is None and "NUM_PROCESSES" in os.environ:
@@ -42,9 +53,21 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:
             pass
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
+
+        def _connect():
+            plan = faults.active()
+            if plan is not None and plan.fires("coord_connect"):
+                raise faults.CoordinatorConnectFault(
+                    f"injected connection refusal to coordinator "
+                    f"{coordinator_address}")
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+
+        policy = retry or faults.RetryPolicy(
+            max_attempts=5, base_delay_s=0.2, max_delay_s=5.0,
+            deadline_s=120.0)
+        policy.call(_connect, component="distributed")
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
@@ -68,7 +91,8 @@ class FaultTolerantTrainer:
     """
 
     def __init__(self, model, checkpoint_dir: str, save_every: int = 100,
-                 keep_last: int = 3, on_restore: Optional[Callable] = None):
+                 keep_last: int = 3, on_restore: Optional[Callable] = None,
+                 max_restarts_without_progress: int = 3):
         from deeplearning4j_tpu.util.checkpoints import TrainingCheckpointer
 
         self.model = model
@@ -88,11 +112,56 @@ class FaultTolerantTrainer:
                         if isinstance(model, (ParallelWrapper, TensorParallel))
                         else model)
         self.save_every = max(1, save_every)
+        self.checkpoint_dir = str(checkpoint_dir)
         self.checkpointer = TrainingCheckpointer(checkpoint_dir,
                                                  keep_last=keep_last)
         self.restored_step = self.checkpointer.restore_latest(self._target)
+        self._check_crash_loop(max_restarts_without_progress)
         if self.restored_step is not None and on_restore:
             on_restore(self.restored_step)
+
+    # --------------------------------------------------- crash-loop bound
+    def _crashloop_path(self) -> str:
+        return os.path.join(self.checkpoint_dir, ".crashloop.json")
+
+    def _check_crash_loop(self, bound: int) -> None:
+        """A relaunch that restores the SAME step as the previous relaunch
+        made no progress — the crash is deterministic (bad batch, poisoned
+        state), and restarting forever burns the pod. Bound it: after
+        ``bound`` restarts at one step, fail loud instead of looping.
+        State lives in a marker file so it survives the process boundary
+        the way the crashes do."""
+        if self.restored_step is None or bound <= 0:
+            return
+        path = self._crashloop_path()
+        count = 1
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            if int(prev.get("step", -1)) == int(self.restored_step):
+                count = int(prev.get("count", 0)) + 1
+        except (OSError, ValueError):
+            pass
+        if jax.process_index() == 0:
+            try:
+                with open(path, "w") as f:
+                    json.dump({"step": int(self.restored_step),
+                               "count": count}, f)
+            except OSError:
+                pass
+        if count > bound:
+            from deeplearning4j_tpu import monitoring
+
+            mon = monitoring.recovery_monitor()
+            if mon is not None:
+                mon.recovery_total.labels(component="trainer",
+                                          outcome="crash_loop").inc()
+            raise RuntimeError(
+                f"crash loop detected: {count} consecutive relaunches "
+                f"restored step {self.restored_step} without progressing "
+                f"past it (bound {bound}). The failure is likely "
+                f"deterministic — inspect the step, the data at it, and "
+                f"{path} before relaunching (delete the file to override).")
 
     def fit_batch(self, ds) -> float:
         loss = self.model.fit_batch(ds)
@@ -102,12 +171,30 @@ class FaultTolerantTrainer:
         return loss
 
     def fit(self, data, epochs: int = 1):
-        for _ in range(epochs):
-            for ds in data:
-                self.fit_batch(ds)
-            if hasattr(data, "reset"):
-                data.reset()
-            self._target.epoch_count += 1
+        try:
+            for _ in range(epochs):
+                for ds in data:
+                    self.fit_batch(ds)
+                if hasattr(data, "reset"):
+                    data.reset()
+                self._target.epoch_count += 1
+        except Exception:
+            # save-on-exception: capture the last good in-memory state so
+            # the relaunch resumes from HERE, not save_every steps back.
+            # Best effort — the original failure always propagates.
+            try:
+                self.checkpointer.save(self._target.step_count, self._target)
+                self.checkpointer.wait()
+                from deeplearning4j_tpu import monitoring
+
+                mon = monitoring.recovery_monitor()
+                if mon is not None:
+                    mon.recovery_total.labels(
+                        component="trainer", outcome="save_on_error").inc()
+            except Exception as save_err:  # noqa: BLE001 — never mask the
+                # original failure with a checkpoint error
+                warnings.warn(f"save-on-exception failed: {save_err}")
+            raise
         self.checkpointer.save(self._target.step_count, self._target)
         self.checkpointer.wait()
         return self.model

@@ -51,11 +51,21 @@ def place_moe_params(params, mesh):
 
 
 def switch_moe(params, x, *, capacity_factor: float = 1.25,
-               activation=jax.nn.relu):
+               activation=jax.nn.relu, overflow_passes: int = 2):
     """Top-1 switch MoE feed-forward. x [..., D] -> (y [..., D], aux_loss).
 
     aux_loss is the switch-transformer load-balancing term
     (n_experts * Σ_e fraction_e * mean_gate_e).
+
+    ``overflow_passes``: tokens past their first-choice expert's capacity
+    fall back to their next-best expert with spare room (the Switch
+    Transformer "no token left behind" pass; GShard's top-k fallback).
+    Under an imbalanced router — exactly the early-training state the aux
+    loss exists to fix — pure top-1 dropping starves a large token
+    fraction of BOTH output and expert gradient, which stalls training;
+    the fallback keeps those tokens learning while the aux loss
+    rebalances. 1 = strict top-1 dropping. Each token is still processed
+    by exactly one expert either way.
     """
     orig_shape = x.shape
     D = orig_shape[-1]
@@ -66,24 +76,37 @@ def switch_moe(params, x, *, capacity_factor: float = 1.25,
 
     logits = xt @ params["router_W"]                     # [N, E]
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(gates, axis=-1)              # [N]
-    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)   # [N, E]
-    gate_val = (gates * onehot).sum(-1)                  # [N]
+    order = jnp.argsort(-gates, axis=-1)                 # ranked choices
+    onehot = jax.nn.one_hot(order[:, 0], E, dtype=jnp.float32)  # [N, E]
 
-    # position of each token in its expert's queue; drop past capacity
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot    # [N, E]
-    keep = onehot * (pos < C)
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C) * keep[..., None]  # [N,E,C]
+    # greedy multi-pass placement: pass p lets every still-unplaced token
+    # try its rank-p expert, consuming the capacity earlier passes left
+    pos_oh = jnp.zeros((N, E, C), jnp.float32)           # [N,E,C] dispatch
+    gate_val = jnp.zeros((N,), jnp.float32)
+    placed = jnp.zeros((N,), jnp.float32)
+    used = jnp.zeros((E,), jnp.float32)                  # capacity consumed
+    for p in range(max(1, min(overflow_passes, E))):
+        oh = (jax.nn.one_hot(order[:, p], E, dtype=jnp.float32)
+              * (1.0 - placed)[:, None])                 # [N, E]
+        pos = ((jnp.cumsum(oh, axis=0) - 1.0) + used[None, :]) * oh
+        keep = oh * (pos < C)
+        pos_oh = pos_oh + jax.nn.one_hot(
+            pos.astype(jnp.int32), C) * keep[..., None]
+        placed_now = keep.sum(-1)                        # [N] 0/1
+        gate_val = gate_val + (gates * keep).sum(-1)
+        used = used + keep.sum(0)
+        placed = placed + placed_now
 
     # dispatch -> expert compute (batched over E; shard E over "model") -> combine
     xin = jnp.einsum("nec,nd->ecd", pos_oh, xt.astype(jnp.float32))
     h = activation(jnp.einsum("ecd,edh->ech", xin, params["W1"]) + params["b1"])
     out = jnp.einsum("ech,ehd->ecd", h, params["W2"]) + params["b2"]
     yt = jnp.einsum("nec,ecd->nd", pos_oh, out) * gate_val[:, None]
-    # overflow tokens (dropped by capacity) contribute zero -> caller's
-    # residual connection passes them through
+    # tokens no pass could place contribute zero -> caller's residual
+    # connection passes them through
 
-    # load-balancing auxiliary loss
+    # load-balancing auxiliary loss (first-choice routing fractions, the
+    # standard switch term — fallback placement doesn't change the target)
     fraction = onehot.mean(0)                             # tokens per expert
     mean_gate = gates.mean(0)
     aux = E * jnp.sum(fraction * mean_gate)
@@ -91,9 +114,10 @@ def switch_moe(params, x, *, capacity_factor: float = 1.25,
 
 
 def switch_moe_reference(params, x, *, capacity_factor: float = 1.25,
-                         activation=jax.nn.relu):
-    """Loop-over-experts reference (for parity tests): identical math,
-    no dispatch tensors."""
+                         activation=jax.nn.relu, overflow_passes: int = 2):
+    """Loop-over-experts reference (for parity tests): identical math —
+    including the greedy multi-pass overflow placement — no dispatch
+    tensors."""
     orig_shape = x.shape
     D = orig_shape[-1]
     xt = np.asarray(x, np.float32).reshape(-1, D)
@@ -104,16 +128,21 @@ def switch_moe_reference(params, x, *, capacity_factor: float = 1.25,
     logits = xt @ rw
     g = np.exp(logits - logits.max(-1, keepdims=True))
     g = g / g.sum(-1, keepdims=True)
-    idx = g.argmax(-1)
+    order = np.argsort(-g, axis=-1)
     y = np.zeros_like(xt)
     counts = np.zeros(E, int)
-    for n in range(N):
-        e = idx[n]
-        if counts[e] >= C:
-            continue
-        counts[e] += 1
-        pre = xt[n] @ np.asarray(params["W1"][e]) + np.asarray(params["b1"][e])[0]
-        h = np.asarray(activation(jnp.asarray(pre)))
-        out = h @ np.asarray(params["W2"][e]) + np.asarray(params["b2"][e])[0]
-        y[n] = out * g[n, e]
+    placed = np.zeros(N, bool)
+    for p in range(max(1, min(overflow_passes, E))):
+        for n in range(N):
+            if placed[n]:
+                continue
+            e = order[n, p]
+            if counts[e] >= C:
+                continue
+            counts[e] += 1
+            placed[n] = True
+            pre = xt[n] @ np.asarray(params["W1"][e]) + np.asarray(params["b1"][e])[0]
+            h = np.asarray(activation(jnp.asarray(pre)))
+            out = h @ np.asarray(params["W2"][e]) + np.asarray(params["b2"][e])[0]
+            y[n] = out * g[n, e]
     return y.reshape(orig_shape)

@@ -15,6 +15,14 @@ caller forever), forward-pass errors are fanned back to every waiter of the
 batch instead of silently killing the worker thread, and ``stop(drain=True)``
 flushes already-admitted requests before joining — the graceful-drain half
 of the gateway lifecycle.
+
+Self-healing (fault-injection PR): the worker is SUPERVISED. A crash that
+escapes the forward-pass handler (ragged stack, injected ``infer_crash``,
+a bug anywhere in dispatch) fans the error back to the in-flight batch and
+revives the loop in place; a thread found dead at submit time is restarted
+before the request is admitted. Every revival increments ``restarts`` and
+``dl4j_recovery_total{component="serving"}``, and ``healthy()`` feeds the
+gateway's degraded-state /healthz report.
 """
 
 from __future__ import annotations
@@ -75,6 +83,11 @@ class ParallelInference:
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._accepting = False
+        # self-healing bookkeeping: how many times the worker loop was
+        # revived after an unexpected death (crash escaping the per-batch
+        # handler, or a thread found dead at submit time)
+        self.restarts = 0
+        self._restart_lock = threading.Lock()
 
     # --- synchronous one-shot API (ParallelInference.output) ---
     def output(self, x):
@@ -119,27 +132,87 @@ class ParallelInference:
         undispatched past it is resolved with :class:`DeadlineExceeded`
         rather than executed. Raises ``queue.Full`` when a bounded queue is
         at capacity and ``RuntimeError`` when the server is not accepting
-        (stopped or draining).
+        (stopped or draining). A worker thread found dead (it should be
+        running while accepting) is restarted before the request is
+        admitted — no request enters a queue nothing is consuming.
         """
         if not self._accepting:
             raise RuntimeError("ParallelInference is not accepting requests "
                                "(stopped or draining)")
+        if (self._worker is not None and not self._worker.is_alive()
+                and not self._stop.is_set()):
+            self._revive("dead_thread")
         out: queue.Queue = queue.Queue(maxsize=1)
         self._q.put_nowait((np.asarray(x), out, deadline))
         return out
 
+    def healthy(self) -> bool:
+        """True while the worker is running (or intentionally stopped);
+        False only in the degraded window between a worker death and its
+        revival."""
+        return (self._worker is None or self._worker.is_alive()
+                or self._stop.is_set())
+
+    def _record_restart(self, outcome: str):
+        with self._restart_lock:
+            self.restarts += 1
+        mon = monitoring.recovery_monitor()
+        if mon is not None:
+            mon.recovery_total.labels(component="serving",
+                                      outcome=outcome).inc()
+
+    def _revive(self, outcome: str):
+        """Restart a dead worker thread (detected at submit time). Queued
+        requests are preserved — the new thread drains them."""
+        with self._restart_lock:
+            if (self._worker is not None and not self._worker.is_alive()
+                    and not self._stop.is_set()):
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+            else:
+                return
+        mon = monitoring.recovery_monitor()
+        if mon is not None:
+            mon.recovery_total.labels(component="serving",
+                                      outcome=outcome).inc()
+        with self._restart_lock:
+            self.restarts += 1
+
     def _run(self):
         while not self._stop.is_set():
-            batch = []
             try:
-                batch.append(self._q.get(timeout=0.05))
-            except queue.Empty:
+                self._serve_once()
+            except Exception:  # noqa: BLE001 — a crash that escaped the
+                # forward-pass handler (ragged np.stack, injected
+                # infer_crash, a bug outside the forward try) used to kill
+                # the thread and hang every queued future. _serve_once
+                # already fanned the error to the in-flight batch; revive
+                # the loop in place and keep serving.
+                self._record_restart("worker_restarted")
                 continue
-            while len(batch) < self.batch_limit:
-                try:
-                    batch.append(self._q.get(timeout=self.queue_timeout_s))
-                except queue.Empty:
-                    break
+
+    def _serve_once(self):
+        """Pull + dispatch one batch. Any exception after requests are
+        dequeued is fanned back to every unresolved waiter before it
+        propagates — no future is ever silently dropped."""
+        batch = []
+        try:
+            batch.append(self._q.get(timeout=0.05))
+        except queue.Empty:
+            return
+        while len(batch) < self.batch_limit:
+            try:
+                batch.append(self._q.get(timeout=self.queue_timeout_s))
+            except queue.Empty:
+                break
+        pending = list(batch)       # not yet resolved with a result/error
+        try:
+            from deeplearning4j_tpu import faults
+
+            plan = faults.active()
+            if plan is not None and plan.fires("infer_crash"):
+                raise faults.InferenceWorkerCrash(
+                    "injected inference-worker crash")
             # shed deadline-expired requests BEFORE dispatch: their callers
             # get an immediate DeadlineExceeded instead of riding (and
             # paying for) a device batch whose result nobody will read
@@ -149,13 +222,14 @@ class ParallelInference:
                 if item[2] is not None and now > item[2]:
                     item[1].put(DeadlineExceeded(
                         "deadline passed before dispatch"))
+                    pending.remove(item)
                     shed += 1
                 else:
                     live.append(item)
             if shed and self.on_shed is not None:
                 self.on_shed(shed)
             if not live:
-                continue
+                return
             mon = monitoring.serving_monitor()
             if mon is not None:
                 # batch-size distribution + queue backlog at dispatch time
@@ -170,10 +244,19 @@ class ParallelInference:
                     xs = np.concatenate([xs, pad])
             try:
                 ys = np.asarray(self.output(xs))[:n]
-            except Exception as e:  # noqa: BLE001 — fan the failure back to
-                # every waiter; a dead worker thread would block them forever
-                for _, out, _ in live:
-                    out.put(e)
-                continue
-            for (x, out, _), y in zip(live, ys):
-                out.put(y)
+            except Exception as e:  # noqa: BLE001 — an EXPECTED failure
+                # mode (bad input, OOM): fan it back and keep the loop —
+                # not a worker crash, so no restart is counted
+                for item in live:
+                    item[1].put(e)
+                    pending.remove(item)
+                return
+            for item, y in zip(live, ys):
+                item[1].put(y)
+                pending.remove(item)
+        except Exception as e:  # noqa: BLE001 — crash path: resolve every
+            # still-pending waiter with the error, then escalate to _run
+            # for the restart accounting
+            for item in pending:
+                item[1].put(e)
+            raise

@@ -62,10 +62,25 @@ class DeviceMesh:
         return NamedSharding(self.mesh, P(*spec))
 
     def shard_batch(self, tree):
-        """Device-put a host batch with dim-0 sharded over the data axis."""
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, self.batch_sharding(np.ndim(x))), tree
-        )
+        """Device-put a host batch with dim-0 sharded over the data axis.
+
+        Multi-process: built with make_array_from_callback (each process
+        feeds its addressable shards from the full host batch it already
+        holds). device_put onto a cross-process sharding would run
+        multihost_utils.assert_equal — a broadcast_one_to_all collective
+        per batch, which on the Gloo CPU transport races any still-in-
+        flight train-step collective and aborts the pair (gloo EnforceNotMet
+        "op.preamble.length <= op.nbytes")."""
+
+        def put(x):
+            sh = self.batch_sharding(np.ndim(x))
+            if jax.process_count() > 1:
+                a = np.asarray(x)
+                return jax.make_array_from_callback(a.shape, sh,
+                                                    lambda idx: a[idx])
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(put, tree)
 
     def replicate(self, tree):
         return jax.device_put(tree, self.replicated())

@@ -110,21 +110,22 @@ class ParameterAveragingTrainer:
         skip = self.skip_average
         has_mask = "mask" in batch_keys
         has_lmask = "label_mask" in batch_keys
-
-        def avg_state_leaf(t):
-            # running stats (floats) are averaged at sync, like the
-            # reference's parameter averaging of the full param vector;
-            # integer leaves (counters) advance identically per replica
-            # and pass through
-            if jnp.issubdtype(t.dtype, jnp.floating):
-                return lax.pmean(t, axis)
-            return t
+        # elastic rounds (an "active" flag in the batch): the average is
+        # renormalized over the surviving replicas — a lost worker's local
+        # steps are excluded, and because every replica leaves the round
+        # holding the (survivor-weighted) average, the lost one re-enters
+        # the next round synced to the group: re-admission is the algebra,
+        # not a special case
+        has_active = "active" in batch_keys
 
         def round_fn(carry, batch):
             """One averaging round: K purely-local steps, then ONE pmean.
             batch: dict of [K, local_batch, ...] arrays — K microbatches
             for this replica ("x"/"y" always; "mask"/"label_mask" (r5)
-            when the stream carries them)."""
+            when the stream carries them; "active" is the per-replica
+            survival flag and rides OUTSIDE the K-step scan)."""
+            batch = dict(batch)
+            active = batch.pop("active", None)
             params = jax.tree_util.tree_map(lambda t: t[0], carry["params"])
             opt = jax.tree_util.tree_map(lambda t: t[0], carry["opt"])
             if stateful:
@@ -165,10 +166,27 @@ class ParameterAveragingTrainer:
                 (params, opt, step), losses = lax.scan(
                     local_step, (params, opt, carry["step"]), batch)
             # the round's single collective: average the diverged replicas
-            # (frozen entries pass through untouched — see skip_average)
+            # (frozen entries pass through untouched — see skip_average).
+            # Elastic rounds weight the mean by each replica's active flag
+            # and renormalize by the survivor count.
+            if has_active:
+                w = active[0]                           # this shard's 0/1
+                survivors = lax.psum(w, axis)
+                pleaf = lambda a: lax.psum(a * w, axis) / survivors
+            else:
+                pleaf = lambda a: lax.pmean(a, axis)
+
+            def avg_state_leaf(t):
+                # running stats (floats) are averaged at sync, like the
+                # reference's parameter averaging of the full param
+                # vector; integer leaves (counters) advance identically
+                # per replica and pass through
+                if jnp.issubdtype(t.dtype, jnp.floating):
+                    return pleaf(t)
+                return t
+
             def avg_tree(tree):
-                pm = lambda t: jax.tree_util.tree_map(
-                    lambda a: lax.pmean(a, axis), t)
+                pm = lambda t: jax.tree_util.tree_map(pleaf, t)
                 if skip is None:
                     return pm(tree)
                 if isinstance(tree, dict):
@@ -188,7 +206,7 @@ class ParameterAveragingTrainer:
                                                       net_state)
                 out["rng"] = jax.random.key_data(
                     jax.random.fold_in(round_key, step))
-            return out, lax.pmean(losses.mean(), axis)
+            return out, pleaf(losses.mean())
 
         spec_rep = {
             "params": jax.tree_util.tree_map(lambda _: P(axis),
@@ -200,7 +218,9 @@ class ParameterAveragingTrainer:
             spec_rep["state"] = jax.tree_util.tree_map(lambda _: P(axis),
                                                        carry["state"])
             spec_rep["rng"] = P()
-        batch_specs = {k: (P(None) if k == "denom" else P(None, axis))
+        batch_specs = {k: (P(None) if k == "denom"
+                           else P(axis) if k == "active"
+                           else P(None, axis))
                        for k in batch_keys}
         fn = shard_map(
             round_fn, mesh=self.mesh,
@@ -213,7 +233,7 @@ class ParameterAveragingTrainer:
         )
         return jax.jit(fn)
 
-    def fit_round(self, carry, x, y, mask=None, label_mask=None):
+    def fit_round(self, carry, x, y, mask=None, label_mask=None, lost=None):
         """One full averaging round over a global batch.
 
         x/y: [K * global_batch, ...] arrays — or dicts of them (r5: the
@@ -223,7 +243,13 @@ class ParameterAveragingTrainer:
         parameter average runs. ``mask``/``label_mask`` (r5): optional
         [K * global_batch, T] masks riding the same split — the stateful
         as_loss_fn surface normalizes each local step by its shard's
-        valid count (single-input/-output only). Returns (carry, loss)."""
+        valid count (single-input/-output only).
+
+        ``lost``: replica indices whose contribution this round is DROPPED
+        (crashed/straggling workers): the average renormalizes over the
+        survivors, and every replica — including the lost ones — leaves
+        the round holding that survivor average, so a recovered worker is
+        re-admitted in sync next round. Returns (carry, loss)."""
         import numpy as np
 
         if (mask is not None or label_mask is not None) and not self.stateful:
@@ -266,6 +292,16 @@ class ParameterAveragingTrainer:
             lambda v: v.reshape((K, n // K) + v.shape[1:]), batch)
         if denom is not None:
             batch["denom"] = denom
+        if lost:
+            bad = [i for i in lost if not 0 <= int(i) < dp]
+            if bad:
+                raise ValueError(f"lost replica indices {bad} outside the "
+                                 f"{dp}-replica data axis")
+            if len(set(int(i) for i in lost)) >= dp:
+                raise ValueError("cannot drop every replica from a round")
+            act = np.ones(dp, np.float32)
+            act[[int(i) for i in lost]] = 0.0
+            batch["active"] = jnp.asarray(act)
         keys = frozenset(batch)
         if self._round is None or self._round_keys != keys:
             self._round = self._build(carry, keys)

@@ -32,17 +32,25 @@ from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
 @dataclasses.dataclass
 class ParameterAveragingTrainingMaster:
-    """Config carrier (ParameterAveragingTrainingMaster.Builder analog)."""
+    """Config carrier (ParameterAveragingTrainingMaster.Builder analog).
+
+    ``straggler_timeout_s`` (> 0 enables it) is the per-round straggler
+    budget for K>1 local SGD: a worker whose round overruns it has its
+    contribution dropped and the average renormalized over the survivors
+    (it re-enters synced the next round). 0 keeps the classic behavior —
+    every round waits for every worker."""
 
     batch_size_per_worker: int = 32
     averaging_frequency: int = 1  # >1 routes fit() to real local SGD
     worker_prefetch_num_batches: int = 2
+    straggler_timeout_s: float = 0.0
 
     class Builder:
         def __init__(self, rdd_data_set_num_examples: int = 1):
             self._batch = 32
             self._freq = 1
             self._prefetch = 2
+            self._straggler = 0.0
 
         def batch_size_per_worker(self, n: int):
             self._batch = n
@@ -56,11 +64,83 @@ class ParameterAveragingTrainingMaster:
             self._prefetch = n
             return self
 
+        def straggler_timeout_s(self, s: float):
+            self._straggler = float(s)
+            return self
+
         def build(self) -> "ParameterAveragingTrainingMaster":
             return ParameterAveragingTrainingMaster(
                 batch_size_per_worker=self._batch,
                 averaging_frequency=self._freq,
-                worker_prefetch_num_batches=self._prefetch)
+                worker_prefetch_num_batches=self._prefetch,
+                straggler_timeout_s=self._straggler)
+
+
+class RoundSupervisor:
+    """Host-side failure detector for local-SGD rounds.
+
+    In-process SPMD has no per-worker heartbeats — one program either runs
+    or doesn't — so the failure SIGNAL comes from the fault plan
+    (``worker_crash`` / ``collective_delay``), standing in for the
+    coordination-service heartbeat a real pod controller watches. The
+    RESPONSE is real and fully exercised: the flagged replica's
+    contribution is dropped from the round, the average renormalizes over
+    survivors (ParameterAveragingTrainer's elastic round), and the worker
+    is re-admitted — synced to the survivor average — the round after its
+    fault clears. Every action lands in
+    ``dl4j_recovery_total{component="localsgd"}``.
+    """
+
+    def __init__(self, dp: int, straggler_timeout_s: float = 0.0):
+        self.dp = max(1, int(dp))
+        self.timeout_s = float(straggler_timeout_s)
+        self.round = 0
+        self._lost_last: set = set()
+        self.dropped = 0
+        self.readmitted = 0
+
+    def _record(self, outcome: str, n: int = 1):
+        from deeplearning4j_tpu import monitoring
+
+        mon = monitoring.recovery_monitor()
+        if mon is not None:
+            mon.recovery_total.labels(component="localsgd",
+                                      outcome=outcome).inc(n)
+
+    def lost_for_round(self):
+        """Consult the fault plan for this round; returns the sorted list
+        of replica indices to drop (usually empty)."""
+        import time as _time
+
+        from deeplearning4j_tpu import faults
+
+        lost = set()
+        plan = faults.active()
+        if plan is not None:
+            rnd = self.round
+            if plan.fires("worker_crash", round=rnd):
+                lost.add(rnd % self.dp)        # deterministic victim
+                self._record("dropped_worker")
+            if plan.fires("collective_delay", round=rnd):
+                victim = (rnd + 1) % self.dp
+                if self.timeout_s > 0 and plan.delay_s > self.timeout_s:
+                    # the straggler overran the round budget: survivors
+                    # wait only the budget, then drop its contribution
+                    _time.sleep(self.timeout_s)
+                    lost.add(victim)
+                    self._record("dropped_straggler")
+                else:
+                    # no budget (or within it): the whole round waits —
+                    # exactly the stall the timeout exists to bound
+                    _time.sleep(plan.delay_s)
+        back = self._lost_last - lost
+        if back:
+            self.readmitted += len(back)
+            self._record("readmitted", len(back))
+        self.dropped += len(lost)
+        self._lost_last = set(lost)
+        self.round += 1
+        return sorted(lost)
 
 
 # SharedTrainingMaster (gradient sharing over Aeron) collapses to the same
@@ -176,6 +256,9 @@ class SparkDl4jMultiLayer:
         # like the reference master carrying its iteration count across
         # RDD passes)
         conf = self.network.conf
+        supervisor = RoundSupervisor(
+            dp, self.training_master.straggler_timeout_s)
+        self._round_supervisor = supervisor     # introspectable post-fit
         # the multi path serves ComputationGraphs fed MultiDataSets —
         # dispatch on the STREAM's shape, not just graph arity (a
         # 1-in/1-out graph legitimately trains from MultiDataSet RDDs in
@@ -189,7 +272,7 @@ class SparkDl4jMultiLayer:
                 multi, data = self._peek_multi(data)
         if multi:
             carry, have, dropped_tail = self._run_multi_rounds(
-                data, epochs, global_batch, K, trainer, carry)
+                data, epochs, global_batch, K, trainer, carry, supervisor)
         else:
             xs, ys, ms, lms, have = [], [], [], [], 0
             dropped_tail = 0
@@ -221,7 +304,8 @@ class SparkDl4jMultiLayer:
                         carry, loss = trainer.fit_round(
                             carry, np.concatenate(xs), np.concatenate(ys),
                             mask=np.concatenate(ms) if ms else None,
-                            label_mask=np.concatenate(lms) if lms else None)
+                            label_mask=np.concatenate(lms) if lms else None,
+                            lost=supervisor.lost_for_round() or None)
                         self.network.score_value = float(loss)
                         xs, ys, ms, lms, have = [], [], [], [], 0
                 if hasattr(data, "reset"):
@@ -262,7 +346,7 @@ class SparkDl4jMultiLayer:
         return self.network
 
     def _run_multi_rounds(self, data, epochs, global_batch, K, trainer,
-                          carry):
+                          carry, supervisor):
         """r5: MULTI-input/-output ComputationGraph local SGD (reference:
         SparkComputationGraph trains MultiDataSet RDDs). The stream runs
         through _RebatchingMultiIterator (same pooling the K=1 path
@@ -323,7 +407,8 @@ class SparkDl4jMultiLayer:
                     mask=(np.concatenate(round_m) if round_m
                           else None),
                     label_mask=(np.concatenate(round_lm) if round_lm
-                                else None))
+                                else None),
+                    lost=supervisor.lost_for_round() or None)
                 self.network.score_value = float(loss)
                 round_x, round_y, round_m, round_lm, have = \
                     [], [], [], [], 0

@@ -9,7 +9,10 @@ One HTTP server multiplexing many named, versioned models:
     POST /models/unload       {"name", "version"?}
     POST /models/split        {"name", "split": {"v1": 0.9, "v2": 0.1}}
     GET  /models              registry + splits + backlogs
-    GET  /healthz             process liveness (200 once the server is up)
+    GET  /healthz             process liveness (200 once the server is up;
+                              body reports "degraded" + the affected
+                              model workers when any inference worker
+                              died/was self-heal restarted)
     GET  /readyz              traffic readiness (503 until a model is
                               loaded, and again once draining)
     GET  /metrics             Prometheus exposition (process-wide registry)
@@ -199,6 +202,17 @@ class ServingGateway(_HttpServerMixin):
             raise HttpError(503, "no model loaded")
         return {"ready": True, "models": self.registry.names()}
 
+    def _healthz(self, _body):
+        """Liveness stays 200 (the process is up — restart-level health is
+        the balancer's /readyz call), but the body surfaces self-healing
+        state: any model worker currently dead, or revived since load, is
+        listed so operators see degradation before it becomes an outage."""
+        health = self.registry.health()
+        degraded = sorted(k for k, h in health.items()
+                          if not h["healthy"] or h["worker_restarts"] > 0)
+        return {"status": "degraded" if degraded else "alive",
+                "degraded": degraded, "workers": health}
+
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "ServingGateway":
         self._draining = False
@@ -214,7 +228,7 @@ class ServingGateway(_HttpServerMixin):
             self._host, self._port,
             post_routes=post_routes,
             get_routes={
-                "/healthz": lambda _: {"status": "alive"},
+                "/healthz": self._healthz,
                 "/readyz": self._readyz,
                 "/models": lambda _: {"models": self.registry.describe()},
             },

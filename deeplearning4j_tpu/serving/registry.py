@@ -43,6 +43,8 @@ class ModelVersion:
                 "buckets": list(self.buckets),
                 "warmed": sorted(self.warmup_timings),
                 "backlog": self.pi.backlog(),
+                "healthy": self.pi.healthy(),
+                "worker_restarts": self.pi.restarts,
                 "loaded_at": self.loaded_at}
 
 
@@ -203,6 +205,22 @@ class ModelRegistry:
         """At least one servable version registered."""
         with self._lock:
             return any(self._models.values())
+
+    def health(self) -> dict:
+        """Per-(name, version) worker health: ``healthy`` is False only in
+        the window between a worker-thread death and its revival;
+        ``worker_restarts`` counts every self-healing event so far."""
+        with self._lock:
+            all_versions = [mv for versions in self._models.values()
+                            for mv in versions.values()]
+        return {
+            f"{mv.name}/{mv.version}": {
+                "healthy": mv.pi.healthy(),
+                "worker_restarts": mv.pi.restarts,
+                "backlog": mv.pi.backlog(),
+            }
+            for mv in all_versions
+        }
 
     def describe(self) -> dict:
         with self._lock:

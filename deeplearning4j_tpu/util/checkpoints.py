@@ -1,4 +1,4 @@
-"""Sharded / async checkpoints (orbax).
+"""Sharded / async checkpoints (orbax) with durability hardening.
 
 Reference analog (SURVEY.md §5 "Checkpoint / resume"): ModelSerializer's
 zip (configuration.json + coefficients.bin + updaterState.bin) covers
@@ -6,23 +6,74 @@ interchange — that lives in util.serialization. This module covers the
 *training* checkpoint path the reference lacks at TPU scale: step-indexed
 async checkpoints of {params, opt_state, step} with keep-last-N retention,
 written with orbax so multi-host sharded arrays save/restore correctly.
+
+Durability contract (the part Spark gave the reference for free):
+
+- every save writes a sidecar **integrity manifest**
+  (``manifest-<step>.json``: tree structure + per-leaf payload checksums);
+- :meth:`TrainingCheckpointer.restore` validates the restored payload
+  against the manifest and raises :class:`CheckpointCorrupt` on mismatch;
+- :meth:`TrainingCheckpointer.restore_latest` walks steps newest-first and
+  **falls back to the newest valid step** instead of raising — a torn or
+  corrupted latest checkpoint costs save_every steps, never the job;
+- retention (keep-last-N) never deletes the newest step that proved
+  restorable (the last known-good);
+- save/restore I/O runs under a shared :class:`faults.RetryPolicy`; every
+  recovery is counted in ``dl4j_recovery_total{component="checkpoint"}``.
+
+Fault-injection points (deeplearning4j_tpu.faults): ``ckpt_io`` fails the
+orbax save/restore call with an OSError; ``ckpt_corrupt`` truncates a
+committed step's payload files on disk after the save — the torn-write
+simulation the fallback path is tested against.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
+import warnings
+import zlib
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
 
 
-def _manager(directory: str, keep_last: int, async_save: bool):
+class CheckpointCorrupt(Exception):
+    """A restored payload failed manifest validation (structure mismatch or
+    checksum mismatch) — the step is not a valid recovery point."""
+
+
+def _manager(directory: str, async_save: bool):
     import orbax.checkpoint as ocp
 
+    # retention is OURS (see _prune): orbax's max_to_keep would happily
+    # delete the last known-good step while a newer, corrupt one survives
     options = ocp.CheckpointManagerOptions(
-        max_to_keep=keep_last, enable_async_checkpointing=async_save)
+        max_to_keep=None, enable_async_checkpointing=async_save)
     return ocp.CheckpointManager(Path(directory).absolute(), options=options)
+
+
+def _flatten(payload) -> Dict[str, Any]:
+    """{keypath-string: leaf} in deterministic order."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(payload)[0]
+    return {jax.tree_util.keystr(kp): leaf for kp, leaf in leaves}
+
+
+def _checksum(leaf) -> Optional[int]:
+    """crc32 of the leaf's host bytes; None when the leaf isn't fully
+    addressable from this process (cross-host shards — those bytes are
+    validated by the process that owns them)."""
+    import numpy as np
+
+    try:
+        a = np.asarray(leaf)
+    except Exception:
+        return None
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
 
 
 class TrainingCheckpointer:
@@ -30,37 +81,151 @@ class TrainingCheckpointer:
 
         ckpt = TrainingCheckpointer(dir, keep_last=3)
         ckpt.save(step, model)           # async by default
-        step = ckpt.restore_latest(model)  # in-place restore, returns step
+        step = ckpt.restore_latest(model)  # newest VALID step, or None
     """
 
     def __init__(self, directory: str, keep_last: int = 3,
-                 async_save: bool = True):
-        self.directory = str(directory)
-        self._mgr = _manager(self.directory, keep_last, async_save)
+                 async_save: bool = True, retry=None):
+        from deeplearning4j_tpu.faults import RetryPolicy
 
+        self.directory = str(directory)
+        self.keep_last = max(1, int(keep_last))
+        self._mgr = _manager(self.directory, async_save)
+        self._retry = retry or RetryPolicy(
+            max_attempts=4, base_delay_s=0.05, max_delay_s=1.0,
+            deadline_s=60.0)
+        self._last_good: Optional[int] = None
+        self._closed = False
+
+    # ----------------------------------------------------------- manifests
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest-{int(step)}.json")
+
+    def _write_manifest(self, step: int, payload) -> None:
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        flat = _flatten(payload)
+        manifest = {
+            "step": int(step),
+            "created": time.time(),
+            "structure": sorted(flat),
+            "checksums": {k: _checksum(v) for k, v in flat.items()},
+        }
+        path = self._manifest_path(step)
+        tmp = path + ".tmp"
+        os.makedirs(self.directory, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)          # atomic: no torn manifests
+
+    def _validate(self, step: int, payload) -> None:
+        """Raise CheckpointCorrupt when the restored payload disagrees with
+        the step's manifest; a missing manifest is accepted (pre-manifest
+        checkpoints stay restorable) with a warning."""
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            warnings.warn(f"checkpoint step {step} has no integrity "
+                          f"manifest; restoring unvalidated")
+            return
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorrupt(
+                f"step {step}: unreadable manifest ({e})") from e
+        flat = _flatten(payload)
+        if sorted(flat) != manifest["structure"]:
+            raise CheckpointCorrupt(
+                f"step {step}: restored tree structure does not match the "
+                f"manifest ({len(flat)} leaves vs "
+                f"{len(manifest['structure'])})")
+        for key, want in manifest["checksums"].items():
+            if want is None:
+                continue
+            got = _checksum(flat[key])
+            if got is not None and got != want:
+                raise CheckpointCorrupt(
+                    f"step {step}: payload checksum mismatch at {key} "
+                    f"(stored {want}, restored {got})")
+
+    # ------------------------------------------------------------- saving
     def save(self, step: int, model) -> None:
         import orbax.checkpoint as ocp
 
+        from deeplearning4j_tpu import faults, monitoring
+
         payload = {"params": model.params, "state": model.state,
                    "opt_state": model.opt_state}
-        from deeplearning4j_tpu import monitoring
+        plan = faults.active()
+
+        def _submit():
+            if plan is not None and plan.fires("ckpt_io", step=step):
+                raise faults.CheckpointIOFault(
+                    f"injected checkpoint I/O failure at step {step}")
+            self._mgr.save(step, args=ocp.args.StandardSave(payload))
 
         mon = monitoring.checkpoint_monitor()
         if mon is None:
-            self._mgr.save(step, args=ocp.args.StandardSave(payload))
-            return
-        import jax
+            self._retry.call(_submit, component="checkpoint")
+        else:
+            import jax
 
-        nbytes = sum(getattr(leaf, "nbytes", 0)
-                     for leaf in jax.tree_util.tree_leaves(payload))
-        with monitoring.span("checkpoint.save", step=step, bytes=nbytes):
-            t0 = time.perf_counter()
-            self._mgr.save(step, args=ocp.args.StandardSave(payload))
-            # async saves: this is the SUBMIT cost the fit loop pays; the
-            # background write finishes under wait()
-            mon.save_seconds.observe(time.perf_counter() - t0)
-        mon.saved_bytes.inc(nbytes)
-        mon.saves.inc()
+            nbytes = sum(getattr(leaf, "nbytes", 0)
+                         for leaf in jax.tree_util.tree_leaves(payload))
+            with monitoring.span("checkpoint.save", step=step, bytes=nbytes):
+                t0 = time.perf_counter()
+                # async saves: this is the SUBMIT cost the fit loop pays;
+                # the background write finishes under wait()
+                self._retry.call(_submit, component="checkpoint")
+                mon.save_seconds.observe(time.perf_counter() - t0)
+            mon.saved_bytes.inc(nbytes)
+            mon.saves.inc()
+        self._write_manifest(step, payload)
+        if plan is not None and plan.fires("ckpt_corrupt", step=step):
+            # torn-write simulation: commit, then truncate payload files
+            self.wait()
+            self._corrupt_step(step)
+        self._prune()
+
+    def _corrupt_step(self, step: int) -> None:
+        """Truncate every non-trivial payload file under the committed step
+        directory (the injected ``ckpt_corrupt`` action)."""
+        root = os.path.join(self.directory, str(int(step)))
+        for dirpath, _dirs, files in os.walk(root):
+            for name in files:
+                path = os.path.join(dirpath, name)
+                try:
+                    if os.path.getsize(path) > 16:
+                        with open(path, "r+b") as f:
+                            f.truncate(os.path.getsize(path) // 2)
+                except OSError:
+                    continue
+
+    def _prune(self) -> None:
+        """Keep the newest ``keep_last`` steps plus the last known-good one
+        (never delete the only step that provably restores)."""
+        try:
+            steps = sorted(self._mgr.all_steps())
+        except Exception:
+            return
+        if len(steps) <= self.keep_last:
+            return
+        keep = set(steps[-self.keep_last:])
+        if self._last_good is not None and self._last_good in steps:
+            keep.add(self._last_good)
+        for s in steps:
+            if s in keep:
+                continue
+            try:
+                self._mgr.delete(s)
+            except Exception:
+                continue
+            try:
+                os.remove(self._manifest_path(s))
+            except OSError:
+                pass
 
     def wait(self):
         self._mgr.wait_until_finished()
@@ -71,19 +236,61 @@ class TrainingCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    # ----------------------------------------------------------- restoring
     def restore_latest(self, model) -> Optional[int]:
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        return self.restore(step, model)
+        """Restore the newest VALID checkpoint: steps are tried newest-first
+        and a step that fails to read or fails manifest validation is
+        skipped (counted as a ``fallback`` recovery) instead of raised."""
+        from deeplearning4j_tpu import monitoring
+
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        for i, step in enumerate(steps):
+            try:
+                restored = self.restore(step, model)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — any unreadable/corrupt
+                # step must not kill the relaunch; the next older step is
+                # the recovery point
+                warnings.warn(f"checkpoint step {step} is not restorable "
+                              f"({type(e).__name__}: {e}); falling back to "
+                              f"the previous step")
+                continue
+            if i > 0:
+                mon = monitoring.recovery_monitor()
+                if mon is not None:
+                    mon.recovery_total.labels(
+                        component="checkpoint", outcome="fallback").inc()
+            return restored
+        if steps:
+            mon = monitoring.recovery_monitor()
+            if mon is not None:
+                mon.recovery_total.labels(
+                    component="checkpoint",
+                    outcome="no_valid_checkpoint").inc()
+            warnings.warn(
+                f"no restorable checkpoint among steps {steps}; starting "
+                f"from scratch")
+        return None
 
     def restore(self, step: int, model) -> int:
         import orbax.checkpoint as ocp
 
+        from deeplearning4j_tpu import faults
+
         template = {"params": model.params, "state": model.state,
                     "opt_state": model.opt_state}
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(template))
+        plan = faults.active()
+
+        def _read():
+            if plan is not None and plan.fires("ckpt_io", step=step):
+                raise faults.CheckpointIOFault(
+                    f"injected checkpoint read failure at step {step}")
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+
+        restored = self._retry.call(_read, component="checkpoint")
+        self._validate(step, restored)
         # hand back HOST arrays (r5): the consuming trainer re-places them
         # exactly like a fresh init. Assigning the restored device arrays
         # directly would make a multi-host relaunch's replication a
@@ -95,25 +302,42 @@ class TrainingCheckpointer:
         model.state = jax.device_get(restored["state"])
         model.opt_state = jax.device_get(restored["opt_state"])
         model.step_count = int(step)
+        self._last_good = int(step)
         return int(step)
 
     def close(self):
+        """Idempotent: safe to call from both user code and trainer
+        teardown paths."""
+        if self._closed:
+            return
+        self._closed = True
         self._mgr.wait_until_finished()
         self._mgr.close()
 
 
 class AsyncCheckpointListener(TrainingListener):
     """Listener wiring the checkpointer into fit() (CheckpointListener's
-    role, with async sharded saves instead of zip writes)."""
+    role, with async sharded saves instead of zip writes). The final step
+    is always saved when fit() completes — a run's last state is
+    restorable even when its step count never hits the save cadence."""
 
     def __init__(self, directory: str, save_every_n_iterations: int = 1000,
                  keep_last: int = 3):
         self.checkpointer = TrainingCheckpointer(directory, keep_last)
         self.every = max(1, save_every_n_iterations)
+        self._last_saved: Optional[int] = None
 
     def iteration_done(self, model, iteration: int, epoch: int, score: float):
         if iteration > 0 and iteration % self.every == 0:
             self.checkpointer.save(iteration, model)
+            self._last_saved = iteration
 
     def on_epoch_end(self, model, epoch: int):
+        self.checkpointer.wait()
+
+    def on_fit_end(self, model):
+        step = int(getattr(model, "step_count", 0))
+        if step and step != self._last_saved:
+            self.checkpointer.save(step, model)
+            self._last_saved = step
         self.checkpointer.wait()

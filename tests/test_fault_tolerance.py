@@ -73,6 +73,32 @@ class TestFaultTolerance:
         assert "RESTORED 10" in r2.stdout
         assert "DONE 30" in r2.stdout
 
+    def test_corrupted_latest_checkpoint_resumes_previous(self, tmp_path):
+        """Kill-and-resume where the newest checkpoint is a torn write:
+        the relaunch must fall back to the previous VALID step (5) and
+        finish — a corrupted latest checkpoint costs save_every steps,
+        never the job."""
+        ckpt = tmp_path / "ck"
+        r1 = _run(ckpt, 30, 12)            # checkpoints at 5, 10
+        assert r1.returncode == 137, r1.stderr[-2000:]
+        # torn write on the newest step: truncate its payload files
+        latest = ckpt / "10"
+        assert latest.is_dir(), sorted(os.listdir(ckpt))
+        clipped = 0
+        for dirpath, _dirs, files in os.walk(latest):
+            for name in files:
+                p = os.path.join(dirpath, name)
+                size = os.path.getsize(p)
+                if size > 16:
+                    with open(p, "r+b") as f:
+                        f.truncate(size // 2)
+                    clipped += 1
+        assert clipped, "nothing to corrupt under the step dir"
+        r2 = _run(ckpt, 30, -1)
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        assert "RESTORED 5" in r2.stdout, r2.stdout[-2000:]
+        assert "DONE 30" in r2.stdout
+
     def test_uninterrupted_run_equivalence(self, tmp_path):
         """Crash+resume reaches the same state as an uninterrupted run
         because restore is exact and data replay is deterministic."""
