@@ -19,6 +19,16 @@ def _flag(name: str, default: bool = False) -> bool:
     return v.strip().lower() not in ("", "0", "false", "off", "no")
 
 
+def _int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return int(v.strip())
+    except ValueError:
+        return default
+
+
 class Environment:
     """Process-wide runtime switches (singleton, like libnd4j Environment)."""
 
@@ -53,6 +63,19 @@ class Environment:
     FAULTS = "DL4J_TPU_FAULTS"
     FAULTS_SEED = "DL4J_TPU_FAULTS_SEED"
     FAULTS_DELAY_S = "DL4J_TPU_FAULTS_DELAY_S"
+    # Async training dispatch (optimize/async_dispatch.py): how many train
+    # steps may be in flight before fit_batch drains the oldest loss.
+    # Default 2 (double-buffered dispatch); 0 restores the per-step
+    # host-sync behavior (fit_batch returns an eager float).
+    ASYNC_STEPS = "DL4J_TPU_ASYNC_STEPS"
+    # Tail-batch padding: pad partial epoch-tail batches up to the pow2
+    # bucket of the largest batch seen (label-mask zeroed — loss-exact) so
+    # ragged tails stop compiling one XLA program per shape. Default ON;
+    # =0 feeds batches through at their raw shapes.
+    PAD_TAIL = "DL4J_TPU_PAD_TAIL"
+    # Persistent XLA compilation cache directory (monitoring/compile.py
+    # wires it plus the dl4j_compile_* metrics tier). Unset = no cache.
+    COMPILE_CACHE = "DL4J_TPU_COMPILE_CACHE"
 
     def __init__(self) -> None:
         self.reload()
@@ -67,6 +90,10 @@ class Environment:
         self.lstm_scan_bwd = _flag(self.LSTM_SCAN_BWD)
         self.gru_scan_bwd = _flag(self.GRU_SCAN_BWD)
         self.import_opt = _flag(self.IMPORT_OPT, True)
+        self.async_steps = max(0, _int(self.ASYNC_STEPS, 2))
+        self.pad_tail = _flag(self.PAD_TAIL, True)
+        self.compile_cache_dir = (os.environ.get(self.COMPILE_CACHE)
+                                  or "").strip() or None
 
 
 env = Environment()

@@ -116,35 +116,51 @@ class AsyncPrefetchIterator(DataSetIterator):
     """Wrap any iterator with a background prefetch thread (AsyncDataSetIterator).
 
     queue_size=2 gives double buffering: batch N+1 is staged while the device
-    runs batch N.
+    runs batch N. With ``device_put`` the staging includes the H2D transfer,
+    so it overlaps the previous step's compute instead of serializing after
+    it; ``sharder`` (a ``batch -> sharded batch`` callable, e.g.
+    ``DeviceMesh.shard_batch`` under ParallelWrapper) replaces the plain
+    single-device put so batches arrive already laid out for the mesh.
     """
 
-    def __init__(self, inner: DataSetIterator, queue_size: int = 2, device_put: bool = True):
+    def __init__(self, inner: DataSetIterator, queue_size: int = 2,
+                 device_put: bool = True, sharder=None):
         super().__init__(getattr(inner, "batch", 0))
         self.inner = inner
         self.queue_size = queue_size
         self.device_put = device_put
+        self.sharder = sharder
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
+
+    def _stage(self, ds: DataSet) -> DataSet:
+        """Move one batch to device (sharded when a sharder is set) on the
+        prefetch thread."""
+        if self.sharder is not None:
+            put = self.sharder
+        else:
+            import jax
+
+            put = jax.device_put
+        return DataSet(
+            put(ds.features), put(ds.labels),
+            None if ds.features_mask is None else put(ds.features_mask),
+            None if ds.labels_mask is None else put(ds.labels_mask),
+        )
 
     def _produce(self):
         q: queue.Queue = queue.Queue(maxsize=self.queue_size)
         stop = threading.Event()
         _END = object()
+        error: list = []
 
         def worker():
             try:
                 for ds in self.inner:
                     if stop.is_set():
                         return
-                    if self.device_put:
-                        import jax
-
-                        ds = DataSet(
-                            jax.device_put(ds.features), jax.device_put(ds.labels),
-                            None if ds.features_mask is None else jax.device_put(ds.features_mask),
-                            None if ds.labels_mask is None else jax.device_put(ds.labels_mask),
-                        )
+                    if self.device_put or self.sharder is not None:
+                        ds = self._stage(ds)
                     # bounded put, re-checking stop: a consumer that
                     # abandons the generator mid-epoch would otherwise
                     # leave this thread blocked on a full queue forever
@@ -157,6 +173,11 @@ class AsyncPrefetchIterator(DataSetIterator):
                             continue
                     if stop.is_set():
                         return
+            except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+                # a source failure (e.g. an exhausted data_io fault retry)
+                # must surface in the training thread, not silently
+                # truncate the epoch
+                error.append(e)
             finally:
                 # deliver _END unless the consumer already hung up (stop):
                 # a live-but-slow consumer must still see the sentinel
@@ -177,6 +198,8 @@ class AsyncPrefetchIterator(DataSetIterator):
                     break
                 yield item
             t.join()
+            if error:
+                raise error[0]
         finally:
             # normal exhaustion, consumer abandonment (GeneratorExit), or
             # an exception downstream: stop the producer and unblock any

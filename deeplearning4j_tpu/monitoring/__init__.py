@@ -47,6 +47,7 @@ _localsgd_mon = None
 _ckpt_mon = None
 _import_mon = None
 _recovery_mon = None
+_compile_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -74,12 +75,12 @@ def reset() -> None:
     the new registry."""
     global _REGISTRY, _tracer, _enabled
     global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
-    global _recovery_mon
+    global _recovery_mon, _compile_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
-    _import_mon = _recovery_mon = None
+    _import_mon = _recovery_mon = _compile_mon = None
 
 
 def metrics_text() -> str:
@@ -124,9 +125,12 @@ def span(name: str, **args):
 
 # ---- per-subsystem instrument bundles -----------------------------------
 class _FitMonitor:
-    """Fit-loop instruments: the per-iteration wall-time split (data wait /
-    device step / listeners) as histograms + spans, plus iteration counter
-    and score gauge."""
+    """Fit-loop instruments: the per-iteration wall-time split as histograms
+    + spans, plus iteration counter and score gauge. Sync mode times
+    "device_step" (dispatch + host fetch, i.e. the device sync); async mode
+    (optimize/async_dispatch) splits that into "dispatch" (enqueue only,
+    host never blocks) and "drain" (the deferred host fetch) — the
+    host-blocked fraction of a fit is then drain/(dispatch+drain)."""
 
     def __init__(self, reg: MetricsRegistry):
         self.reg = reg
@@ -141,6 +145,12 @@ class _FitMonitor:
             "device_step": reg.histogram(
                 "dl4j_train_device_step_seconds",
                 "Host-observed jitted train-step time incl. device sync"),
+            "dispatch": reg.histogram(
+                "dl4j_train_dispatch_seconds",
+                "Async mode: time to enqueue one train step (no host sync)"),
+            "drain": reg.histogram(
+                "dl4j_train_drain_seconds",
+                "Async mode: deferred host fetch of an in-flight loss"),
             "listeners": reg.histogram(
                 "dl4j_train_listener_seconds",
                 "Per-iteration time in host-side listener callbacks"),
@@ -276,6 +286,26 @@ class _RecoveryMonitor:
             labels=("cls",))
 
 
+class _CompileMonitor:
+    """XLA compile-time instruments (monitoring/compile.py bridges
+    jax.monitoring events here): every backend compile lands in
+    ``dl4j_compile_seconds``/``dl4j_compiles_total``; persistent-cache
+    probes (DL4J_TPU_COMPILE_CACHE) in ``dl4j_compile_cache_events_total``
+    by hit/miss — cold-vs-warm process start is one /metrics read."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.compiles = reg.counter(
+            "dl4j_compiles_total", "XLA backend compiles in this process")
+        self.compile_seconds = reg.histogram(
+            "dl4j_compile_seconds", "XLA backend compile durations",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+        self.cache_events = reg.counter(
+            "dl4j_compile_cache_events_total",
+            "Persistent compilation cache probes, by outcome",
+            labels=("kind",))
+
+
 class _ImportMonitor:
     """Import-graph optimizer instruments: per-rule rewrite counts per
     frontend (modelimport/optimizer.py), so the effect of the pass on each
@@ -326,6 +356,10 @@ def recovery_monitor() -> Optional[_RecoveryMonitor]:
     return _bundle("_recovery_mon", _RecoveryMonitor)
 
 
+def compile_monitor() -> Optional[_CompileMonitor]:
+    return _bundle("_compile_mon", _CompileMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 
 __all__ = [
@@ -335,4 +369,5 @@ __all__ = [
     "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
     "fit_monitor", "serving_monitor", "localsgd_monitor",
     "checkpoint_monitor", "import_monitor", "recovery_monitor",
+    "compile_monitor",
 ]

@@ -1,0 +1,98 @@
+"""Compile-time observability + persistent compilation cache wiring.
+
+Reference analog: the reference JIT-compiled nothing — op dispatch cost was
+fixed JNI overhead — so it had no notion of compile-time visibility. In an
+XLA world every new (program, shape) pair costs seconds-to-minutes of
+compilation, and a fit loop that recompiles per ragged tail shape hides that
+cost inside ordinary step time. Two tools here:
+
+- **install_hooks()** registers ``jax.monitoring`` listeners that land every
+  backend compile in ``dl4j_compile_seconds``/``dl4j_compiles_total`` (and
+  persistent-cache hits/misses in ``dl4j_compile_cache_events_total``) when
+  monitoring is enabled — cold-vs-warm compile time becomes a /metrics
+  read. Registration is idempotent and the callbacks fire only on compiles
+  and cache probes, never on the step hot path.
+- **configure_compile_cache()** points JAX's persistent compilation cache at
+  ``DL4J_TPU_COMPILE_CACHE`` (or an explicit path), so warm process starts
+  skip recompiles entirely; applied automatically at package import when
+  the env var is set.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_installed = False
+_configured_dir: Optional[str] = None
+
+
+def install_hooks() -> bool:
+    """Register the jax.monitoring -> metrics-registry bridge (idempotent).
+    Returns True when hooks are (already) installed. The listeners are
+    process-global and permanent — they gate on ``monitoring.enabled()`` at
+    fire time, so the default-off state records nothing."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        import jax.monitoring as jax_monitoring
+    except Exception:
+        return False
+
+    from deeplearning4j_tpu import monitoring
+
+    def _on_duration(event: str, duration: float, **kwargs) -> None:
+        if not event.endswith("backend_compile_duration"):
+            return
+        mon = monitoring.compile_monitor()
+        if mon is None:
+            return
+        mon.compiles.inc()
+        mon.compile_seconds.observe(duration)
+
+    def _on_event(event: str, **kwargs) -> None:
+        kind = None
+        if event.endswith("cache_hits"):
+            kind = "hit"
+        elif event.endswith("cache_misses"):
+            kind = "miss"
+        if kind is None:
+            return
+        mon = monitoring.compile_monitor()
+        if mon is None:
+            return
+        mon.cache_events.labels(kind=kind).inc()
+
+    jax_monitoring.register_event_duration_secs_listener(_on_duration)
+    jax_monitoring.register_event_listener(_on_event)
+    _installed = True
+    return True
+
+
+def configure_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache at ``path`` (default: the
+    ``DL4J_TPU_COMPILE_CACHE`` env var). Returns the directory in effect, or
+    None when unset/unsupported. Also installs the compile metrics hooks so
+    an enabled registry sees the cold-vs-warm split immediately."""
+    from deeplearning4j_tpu.common.env import env
+
+    global _configured_dir
+    path = path or env.compile_cache_dir
+    if not path:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # 0.5s (not the 5s default): small jitted programs — the exact ones
+        # a train loop re-traces per shape — would otherwise never persist
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        return None  # older jax without the knobs
+    install_hooks()
+    _configured_dir = path
+    return path
+
+
+def configured_cache_dir() -> Optional[str]:
+    return _configured_dir

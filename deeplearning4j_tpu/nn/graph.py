@@ -23,8 +23,13 @@ from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.graph import LayerVertex
+from deeplearning4j_tpu.common.env import env
 from deeplearning4j_tpu.nn.multilayer import (
     _check_carry_batch, _tree_cast, _unpack, global_norm_clip,
+)
+from deeplearning4j_tpu.optimize.async_dispatch import (
+    _fetch_scalar, deliver_score, drain_scores, get_window, leading_dim,
+    pad_tail_batch,
 )
 from deeplearning4j_tpu.optimize.updaters import NoOp, get_updater
 
@@ -454,8 +459,47 @@ class ComputationGraph:
             d = {n: jnp.asarray(label_mask) for n in outs}
         return d or None
 
+    def _tail_padding_ok(self) -> bool:
+        """Tail padding is loss-exact for a DAG iff no vertex computes
+        cross-example batch statistics and every network output is a
+        standard per-example-loss head (mirrors multilayer's
+        supports_tail_padding over the vertex set)."""
+        ok = getattr(self, "_pad_ok", None)
+        if ok is None:
+            from deeplearning4j_tpu.nn.layers.norm import BatchNormalizationLayer
+            from deeplearning4j_tpu.nn.layers.output import LossLayer, OutputLayer
+
+            ok = all(not (isinstance(v, LayerVertex)
+                          and isinstance(v.layer, BatchNormalizationLayer)
+                          and not v.layer.use_mean_var_from_state)
+                     for v in self.conf.vertices.values())
+            if ok:
+                for name in self.conf.network_outputs:
+                    v = self.conf.vertices[name]
+                    if not (isinstance(v, LayerVertex)
+                            and isinstance(v.layer, (OutputLayer, LossLayer))):
+                        ok = False
+                        break
+            self._pad_ok = ok
+        return ok
+
     def fit_batch(self, ds) -> float:
+        """One optimization step. Sync mode returns the loss as a float;
+        async mode (optimize/async_dispatch, the default) returns a lazy
+        ScoreHandle — see MultiLayerNetwork.fit_batch."""
         x, y, mask, label_mask = _unpack(ds)
+        if env.pad_tail and not isinstance(y, (list, tuple, dict)):
+            # pad partial epoch tails up to a pow2 bucket (loss-exact via
+            # label-mask zeroing); multi-input x pads per entry, but a
+            # per-output labels LIST/DICT keeps its raw shape (a loss mask
+            # cannot be synthesized for it shape-safely)
+            b = leading_dim(x)
+            max_b = getattr(self, "_fit_max_batch", 0)
+            if b > max_b:
+                self._fit_max_batch = b
+            elif b < max_b and self._tail_padding_ok():
+                x, y, mask, label_mask = pad_tail_batch(
+                    x, y, mask, label_mask, max_b)
         inputs = self._as_input_dict(x)
         labels = self._as_label_dict(y)
         labels_masks = self._labels_masks_for(mask, label_mask)
@@ -470,31 +514,38 @@ class ComputationGraph:
                 jnp.asarray(self.step_count, jnp.int32), inputs, labels,
                 self._next_key(),
                 None if mask is None else [jnp.asarray(mask)], labels_masks)
+        window = get_window(self)
         mon = monitoring.fit_monitor()
         if mon is None:
             # hot path: monitoring off means NO registry/tracer calls here
             self.params, self.state, self.opt_state, loss = fn(*args)
-            self.score_value = float(loss)
-            for lst in self.listeners:
-                lst.iteration_done(self, self.step_count, self.epoch_count,
-                                   self.score_value)
-        else:
+            result = deliver_score(self, loss, window, None)
+        elif window is None:
             with mon.phase("device_step"):
                 self.params, self.state, self.opt_state, loss = fn(*args)
                 # the host fetch is the device sync: step time includes it
-                self.score_value = float(loss)
+                result = self._score_value = _fetch_scalar(loss)
             with mon.phase("listeners"):
                 for lst in self.listeners:
                     lst.iteration_done(self, self.step_count,
-                                       self.epoch_count, self.score_value)
-            mon.iteration_done(self.score_value)
+                                       self.epoch_count, result)
+            mon.iteration_done(result)
+        else:
+            with mon.phase("dispatch"):
+                self.params, self.state, self.opt_state, loss = fn(*args)
+            result = window.submit(loss)  # drains oldest once over capacity
         self.step_count += 1
-        return self.score_value
+        return result
 
     def fit(self, data, labels=None, epochs: int = 1):
         if labels is not None:
-            for _ in range(epochs):
-                self.fit_batch((data, labels))
+            try:
+                for _ in range(epochs):
+                    self.fit_batch((data, labels))
+            except BaseException:
+                drain_scores(self, suppress=True)
+                raise
+            drain_scores(self)
             for lst in self.listeners:
                 lst.on_fit_end(self)
             return self
@@ -504,8 +555,16 @@ class ComputationGraph:
             # data-wait spans time the iterator pull per batch (host input
             # pipeline vs device step split); None = monitoring off
             mon = monitoring.fit_monitor()
-            for ds in (data if mon is None else mon.wrap_batches(data)):
-                self.fit_batch(ds)
+            try:
+                for ds in (data if mon is None else mon.wrap_batches(data)):
+                    self.fit_batch(ds)
+            except BaseException:
+                # best-effort drain; the batch-loop exception wins
+                drain_scores(self, suppress=True)
+                raise
+            # in-flight scores (and any async step failure) land BEFORE the
+            # epoch-end listeners observe the epoch
+            drain_scores(self)
             if hasattr(data, "reset"):
                 data.reset()
             for lst in self.listeners:
@@ -533,6 +592,17 @@ class ComputationGraph:
         if hasattr(iterator, "reset"):
             iterator.reset()
         return ev
+
+    @property
+    def score_value(self) -> float:
+        """Latest training score; under async dispatch reading it drains
+        the in-flight window first (see MultiLayerNetwork.score_value)."""
+        drain_scores(self)
+        return self._score_value
+
+    @score_value.setter
+    def score_value(self, value: float) -> None:
+        self._score_value = value
 
     def score(self, ds=None) -> float:
         """Loss on a batch without updating (ComputationGraph.score(DataSet));

@@ -24,9 +24,14 @@ import numpy as np
 
 from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
+from deeplearning4j_tpu.common.env import env
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
+from deeplearning4j_tpu.optimize.async_dispatch import (
+    _fetch_scalar, deliver_score, drain_scores, get_window, leading_dim,
+    pad_tail_batch, supports_tail_padding,
+)
 from deeplearning4j_tpu.optimize.updaters import NoOp, get_updater
 
 
@@ -326,7 +331,7 @@ class MultiLayerNetwork:
             step_fn = self._make_tbptt_step()
             self._jit_cache["tbptt"] = step_fn
         carries = self._init_carries(x.shape[0])
-        total, n_chunks = 0.0, 0
+        total, n_chunks = None, 0
         # full chunks, then the trailing partial chunk (its different shape
         # compiles once and is cached like any other jit specialization)
         starts = list(range(0, (T // L) * L, L))
@@ -342,14 +347,15 @@ class MultiLayerNetwork:
                 self.params, self.state, self.opt_state,
                 jnp.asarray(self.step_count, jnp.int32), xc, yc, key, mc,
                 carries, lc)
-            total += float(loss)
+            # accumulate ON DEVICE: all chunks stay dispatched back-to-back;
+            # the one host fetch per call happens at score delivery below
+            total = loss if total is None else total + loss
             n_chunks += 1
-        self.score_value = total / max(n_chunks, 1)
-        for lst in self.listeners:
-            lst.iteration_done(self, self.step_count, self.epoch_count,
-                               self.score_value)
+        mean = total / max(n_chunks, 1)
+        result = deliver_score(self, mean, get_window(self),
+                               monitoring.fit_monitor())
         self.step_count += 1
-        return self.score_value
+        return result
 
     # ---------------------------------------------------- stored-state RNN
     def rnn_time_step(self, x):
@@ -396,12 +402,28 @@ class MultiLayerNetwork:
         self._rnn_carries = None
 
     def fit_batch(self, ds) -> float:
-        """One optimization step on a DataSet/(features, labels) pair."""
+        """One optimization step on a DataSet/(features, labels) pair.
+
+        Sync mode (``DL4J_TPU_ASYNC_STEPS=0`` or an eager-score listener)
+        returns the step's loss as a float — the host blocks on the device.
+        Async mode (the default) returns a lazy ScoreHandle and keeps up to
+        ``DL4J_TPU_ASYNC_STEPS`` steps in flight; any numeric use of the
+        handle (or reading ``score()``) drains to a float."""
         x, y, mask, label_mask = _unpack(ds)
         label_mask = _single_mask(label_mask)
         if (self.conf.tbptt_fwd_length > 0 and np.ndim(x) == 3
                 and np.shape(x)[1] > self.conf.tbptt_fwd_length):
             return self._fit_tbptt(x, y, mask, label_mask)
+        if env.pad_tail:
+            # partial epoch tails pad up to a pow2 bucket (loss-exact via
+            # label-mask zeroing) instead of compiling one program per shape
+            b = leading_dim(x)
+            max_b = getattr(self, "_fit_max_batch", 0)
+            if b > max_b:
+                self._fit_max_batch = b
+            elif b < max_b and self._tail_padding_ok():
+                x, y, mask, label_mask = pad_tail_batch(
+                    x, y, mask, label_mask, max_b)
         step_fn = self._jit_cache.get("train")
         if step_fn is None:
             step_fn = self._make_train_step()
@@ -412,32 +434,39 @@ class MultiLayerNetwork:
                 jnp.asarray(y), key,
                 None if mask is None else jnp.asarray(mask),
                 None if label_mask is None else jnp.asarray(label_mask))
+        window = get_window(self)
         mon = monitoring.fit_monitor()
         if mon is None:
             # hot path: monitoring off means NO registry/tracer calls here
             self.params, self.state, self.opt_state, loss = step_fn(*args)
-            self.score_value = float(loss)
-            for lst in self.listeners:
-                lst.iteration_done(self, self.step_count, self.epoch_count,
-                                   self.score_value)
-        else:
+            result = deliver_score(self, loss, window, None)
+        elif window is None:
             with mon.phase("device_step"):
                 self.params, self.state, self.opt_state, loss = step_fn(*args)
                 # the host fetch is the device sync: step time includes it
-                self.score_value = float(loss)
+                result = self._score_value = _fetch_scalar(loss)
             with mon.phase("listeners"):
                 for lst in self.listeners:
                     lst.iteration_done(self, self.step_count,
-                                       self.epoch_count, self.score_value)
-            mon.iteration_done(self.score_value)
+                                       self.epoch_count, result)
+            mon.iteration_done(result)
+        else:
+            with mon.phase("dispatch"):
+                self.params, self.state, self.opt_state, loss = step_fn(*args)
+            result = window.submit(loss)  # drains oldest once over capacity
         self.step_count += 1
-        return self.score_value
+        return result
 
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(iterator) or fit(features, labels) (MultiLayerNetwork.fit overloads)."""
         if labels is not None:
-            for _ in range(epochs):
-                self.fit_batch((data, labels))
+            try:
+                for _ in range(epochs):
+                    self.fit_batch((data, labels))
+            except BaseException:
+                drain_scores(self, suppress=True)
+                raise
+            drain_scores(self)
             for lst in self.listeners:
                 lst.on_fit_end(self)
             return self
@@ -447,8 +476,16 @@ class MultiLayerNetwork:
             # data-wait spans time the iterator pull per batch (host input
             # pipeline vs device step split); None = monitoring off
             mon = monitoring.fit_monitor()
-            for ds in (data if mon is None else mon.wrap_batches(data)):
-                self.fit_batch(ds)
+            try:
+                for ds in (data if mon is None else mon.wrap_batches(data)):
+                    self.fit_batch(ds)
+            except BaseException:
+                # best-effort drain; the batch-loop exception wins
+                drain_scores(self, suppress=True)
+                raise
+            # in-flight scores (and any async step failure) land BEFORE the
+            # epoch-end listeners observe the epoch
+            drain_scores(self)
             if hasattr(data, "reset"):
                 data.reset()
             for lst in self.listeners:
@@ -563,6 +600,25 @@ class MultiLayerNetwork:
         return loss_fn, (self.params, self.state)
 
     # ----------------------------------------------------------------- score
+    @property
+    def score_value(self) -> float:
+        """Latest training score. Under async dispatch
+        (optimize/async_dispatch) reading it drains the in-flight window
+        first — the value is always that of the newest DISPATCHED step,
+        exactly as in sync mode."""
+        drain_scores(self)
+        return self._score_value
+
+    @score_value.setter
+    def score_value(self, value: float) -> None:
+        self._score_value = value
+
+    def _tail_padding_ok(self) -> bool:
+        ok = getattr(self, "_pad_ok", None)
+        if ok is None:
+            ok = self._pad_ok = supports_tail_padding(self.layers)
+        return ok
+
     def score(self, ds=None) -> float:
         """Loss on a dataset without updating (MultiLayerNetwork.score(DataSet))."""
         if ds is None:

@@ -15,6 +15,13 @@ from typing import Any, Callable, Optional
 
 
 class TrainingListener:
+    # Async dispatch (optimize/async_dispatch) defers iteration_done to
+    # drain time so the fit loop never blocks on the score. A listener that
+    # acts on CURRENT model state per iteration (evaluation, checkpointing)
+    # sets this True: its presence forces fit_batch onto the eager (sync)
+    # path, so iteration_done fires with the model exactly at that step.
+    needs_eager_score = False
+
     def iteration_done(self, model, iteration: int, epoch: int, score: float):
         pass
 
@@ -100,6 +107,8 @@ class PerformanceListener(TrainingListener):
 class EvaluativeListener(TrainingListener):
     """Run evaluation every N iterations (EvaluativeListener)."""
 
+    needs_eager_score = True  # evaluates the model AT each iteration
+
     def __init__(self, iterator_factory, frequency: int = 100, evaluator_factory=None,
                  log: Callable[[str], None] = print):
         self.iterator_factory = iterator_factory
@@ -119,6 +128,8 @@ class EvaluativeListener(TrainingListener):
 
 class CheckpointListener(TrainingListener):
     """Periodic model saves with keep-last-N (CheckpointListener)."""
+
+    needs_eager_score = True  # saves the model AT each checkpoint iteration
 
     def __init__(self, directory: str, save_every_n_iterations: int = 1000,
                  keep_last: int = 3):

@@ -88,13 +88,26 @@ class ParallelWrapper:
 
     def fit(self, data, epochs: int = 1):
         from deeplearning4j_tpu.datasets.iterators import AsyncPrefetchIterator
+        from deeplearning4j_tpu.optimize.async_dispatch import drain_scores
 
         if self.prefetch_buffer and hasattr(data, "reset"):
+            # single-process: the prefetch thread shards each batch onto the
+            # mesh, overlapping H2D with the previous step's compute
+            # (fit_batch's shard_batch then passes it through unchanged).
+            # Multi-process stages host-side: make_array_from_callback from
+            # a second thread would interleave on the Gloo transport.
+            sharder = (self.mesh.shard_batch
+                       if jax.process_count() == 1 else None)
             data = AsyncPrefetchIterator(data, queue_size=self.prefetch_buffer,
-                                         device_put=False)
+                                         device_put=False, sharder=sharder)
         for _ in range(epochs):
-            for ds in data:
-                self.fit_batch(ds)
+            try:
+                for ds in data:
+                    self.fit_batch(ds)
+            except BaseException:
+                drain_scores(self.model, suppress=True)
+                raise
+            drain_scores(self.model)
             if hasattr(data, "reset"):
                 data.reset()
             self.model.epoch_count += 1

@@ -74,6 +74,11 @@ class DeviceMesh:
 
         def put(x):
             sh = self.batch_sharding(np.ndim(x))
+            if isinstance(x, jax.Array) and x.sharding == sh:
+                # already laid out (an AsyncPrefetchIterator staged it with
+                # this mesh's sharder): re-putting would serialize the H2D
+                # transfer the prefetch thread just overlapped
+                return x
             if jax.process_count() > 1:
                 a = np.asarray(x)
                 return jax.make_array_from_callback(a.shape, sh,

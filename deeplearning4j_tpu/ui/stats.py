@@ -72,6 +72,10 @@ class StatsListener(TrainingListener):
     timing are recorded every iteration.
     """
 
+    # samples param stats AT each iteration (deferred delivery would read
+    # later weights), and its iteration timing assumes per-step callbacks
+    needs_eager_score = True
+
     def __init__(self, storage: StatsStorage, session_id: str = "default",
                  update_frequency: int = 10, collect_param_stats: bool = True,
                  collect_histograms: bool = True,

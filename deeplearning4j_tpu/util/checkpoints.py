@@ -321,6 +321,8 @@ class AsyncCheckpointListener(TrainingListener):
     is always saved when fit() completes — a run's last state is
     restorable even when its step count never hits the save cadence."""
 
+    needs_eager_score = True  # saves the model AT each checkpoint iteration
+
     def __init__(self, directory: str, save_every_n_iterations: int = 1000,
                  keep_last: int = 3):
         self.checkpointer = TrainingCheckpointer(directory, keep_last)

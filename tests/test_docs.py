@@ -154,6 +154,20 @@ train_iterator = ArrayDataSetIterator(
     _rng.normal(size=(64, 8)).astype(np.float32),
     np.eye(4, dtype=np.float32)[_rng.integers(0, 4, 64)], batch_size=16)
 """,
+    "performance.md": """
+import numpy as np
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+_conf = (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_out=8, activation="relu"))
+         .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.feed_forward(4)).build())
+net = MultiLayerNetwork(_conf).init()
+_rng = np.random.default_rng(0)
+ds = (_rng.normal(size=(16, 4)).astype(np.float32),
+      np.eye(3, dtype=np.float32)[_rng.integers(0, 3, 16)])
+""",
     "rl.md": "",
     "observability.md": """
 import numpy as np

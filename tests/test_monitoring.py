@@ -206,6 +206,9 @@ class TestSpanTracer:
 
 class TestFitInstrumentation:
     def test_fit_populates_registry_and_trace(self, tmp_path):
+        """Async dispatch (the default) splits the old device_step phase
+        into dispatch (enqueue) + drain (deferred fetch); fit() drains every
+        in-flight step by epoch end, so the counts still match 1:1."""
         from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
 
         monitoring.enable()
@@ -217,12 +220,13 @@ class TestFitInstrumentation:
 
         reg = monitoring.registry()
         assert reg.get("dl4j_train_iterations_total").value == 6
-        assert reg.get("dl4j_train_device_step_seconds").count == 6
+        assert reg.get("dl4j_train_dispatch_seconds").count == 6
+        assert reg.get("dl4j_train_drain_seconds").count == 6
         # one data-wait observation per pull, incl. the terminating one
         assert reg.get("dl4j_train_data_wait_seconds").count >= 6
         assert np.isfinite(reg.get("dl4j_train_score").value)
         text = monitoring.metrics_text()
-        assert "dl4j_train_device_step_seconds_bucket" in text
+        assert "dl4j_train_dispatch_seconds_bucket" in text
         assert "dl4j_train_data_wait_seconds_bucket" in text
 
         path = tmp_path / "fit_trace.json"
@@ -230,8 +234,30 @@ class TestFitInstrumentation:
         doc = json.load(open(path))        # acceptance: json.loads cleanly
         validate_nesting(doc["traceEvents"])
         names = {e["name"] for e in doc["traceEvents"]}
-        assert {"fit.data_wait", "fit.device_step",
+        assert {"fit.data_wait", "fit.dispatch", "fit.drain",
                 "fit.listeners"} <= names
+
+    def test_fit_sync_mode_keeps_device_step_accounting(self, monkeypatch):
+        """DL4J_TPU_ASYNC_STEPS=0 restores the original sync accounting:
+        the host fetch is timed inside device_step, no dispatch/drain."""
+        from deeplearning4j_tpu.common.env import env
+        from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+        monkeypatch.setenv("DL4J_TPU_ASYNC_STEPS", "0")
+        env.reload()
+        try:
+            monitoring.enable()
+            model = _model()
+            x, y = _data(16)
+            model.fit(ArrayDataSetIterator(x, y, batch_size=8), epochs=3)
+            reg = monitoring.registry()
+            assert reg.get("dl4j_train_iterations_total").value == 6
+            assert reg.get("dl4j_train_device_step_seconds").count == 6
+            assert reg.get("dl4j_train_dispatch_seconds").count == 0
+            assert reg.get("dl4j_train_drain_seconds").count == 0
+        finally:
+            monkeypatch.delenv("DL4J_TPU_ASYNC_STEPS")
+            env.reload()
 
     def test_graph_fit_batch_instrumented(self):
         monitoring.enable()
@@ -249,9 +275,12 @@ class TestFitInstrumentation:
         x, y = _data(8)
         for _ in range(3):
             net.fit_batch((x, y))
+        # async default: 3 dispatches; reading score_value drains the rest
+        assert np.isfinite(net.score_value)
         reg = monitoring.registry()
         assert reg.get("dl4j_train_iterations_total").value == 3
-        assert reg.get("dl4j_train_device_step_seconds").count == 3
+        assert reg.get("dl4j_train_dispatch_seconds").count == 3
+        assert reg.get("dl4j_train_drain_seconds").count == 3
 
 
 class TestZeroOverheadGuard:
