@@ -1674,6 +1674,125 @@ def bench_serving_gateway(n_requests=384, clients=16, batch_limit=32,
     }
 
 
+def bench_generate(n_requests=48, slots=8, units=256, vocab=77,
+                   budget_deadline=None):
+    """Generation-engine lane (continuous-batching PR): autoregressive
+    decode throughput + streaming SLOs over a mixed-length workload.
+
+    One char-LSTM net (zoo TextGenerationLSTM topology), one slot pool,
+    TWO scheduling policies over the identical seeded workload:
+      - ``continuous``: admit into free slots every step, retire on finish
+        (the engine's production mode);
+      - ``static``: run-to-completion batching — a batch must fully finish
+        before the next is admitted (what a naive fixed-batch sampler
+        does, and the A/B baseline the ISSUE acceptance names).
+    Reported per policy: tokens/sec, TTFT p50/p99, inter-token p99 (all
+    measured at STREAM ARRIVAL by per-request consumer threads, i.e. what
+    a client would see), plus the compile-counter witness — decode must
+    stay ONE program for the whole run. Prompts/max-new are seeded, so the
+    A/B compares schedulers, not workloads; both run after an untimed
+    warmup pass that compiles every prefill bucket."""
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.generation import GenerationEngine
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    lens = rng.integers(4, 48, n_requests)
+    # long-tailed completion mix (the serving reality that motivates
+    # continuous batching): mostly short answers, a minority of long ones
+    # that run-to-completion batching lets block a whole batch's slots
+    news = np.where(rng.random(n_requests) < 0.75,
+                    rng.integers(8, 32, n_requests),
+                    rng.integers(96, 192, n_requests))
+    prompts = [rng.integers(0, vocab, int(l)).tolist() for l in lens]
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(0).list()
+        .layer(LSTMLayer(n_out=units))
+        .layer(LSTMLayer(n_out=units))
+        .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                              loss="mcxent"))
+        .set_input_type(InputType.recurrent(vocab, 64))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+
+    def pctl(xs, q):
+        return (None if not xs
+                else round(float(np.percentile(np.asarray(xs), q)), 2))
+
+    def run(continuous):
+        eng = GenerationEngine(net, slots=slots, max_len=256,
+                               continuous=continuous)
+        # untimed warmup: compiles the decode step + every prefill bucket
+        # this workload touches, so the timed run measures scheduling
+        for p in prompts:
+            eng.submit(p, max_new_tokens=2)
+        eng.drain()
+
+        arrivals = [[] for _ in range(n_requests)]
+        submit_t = [0.0] * n_requests
+        streams, consumers = [], []
+
+        def consume(s, acc):
+            for _ in s:
+                acc.append(time.perf_counter())
+
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            submit_t[i] = time.perf_counter()
+            s = eng.submit(p, max_new_tokens=int(news[i]), temperature=0.8,
+                           top_k=40, seed=i)
+            th = threading.Thread(target=consume, args=(s, arrivals[i]),
+                                  daemon=True)
+            th.start()
+            streams.append(s)
+            consumers.append(th)
+        eng.drain()
+        for th in consumers:
+            th.join()
+        dt = time.perf_counter() - t0
+        total = sum(len(s.tokens) for s in streams)
+        ttft_ms = [(a[0] - submit_t[i]) * 1000.0
+                   for i, a in enumerate(arrivals) if a]
+        inter_ms = np.concatenate(
+            [np.diff(a) * 1000.0 for a in arrivals if len(a) > 1])
+        return {
+            "tokens_per_sec": round(total / dt, 1),
+            "wall_secs": round(dt, 2),
+            "tokens": total,
+            "ttft_p50_ms": pctl(ttft_ms, 50),
+            "ttft_p99_ms": pctl(ttft_ms, 99),
+            "inter_token_p99_ms": pctl(inter_ms.tolist(), 99),
+            "decode_steps": eng.steps_run,
+            "decode_programs": eng.decode_programs,
+            "prefill_programs": eng.prefill_programs,
+        }
+
+    cont = run(continuous=True)
+    out = {
+        "model": f"char-LSTM {units}x2 vocab {vocab}",
+        "workload": {"requests": n_requests, "slots": slots,
+                     "prompt_len": [int(lens.min()), int(lens.max())],
+                     "max_new_tokens": [int(news.min()), int(news.max())]},
+        "continuous": cont,
+    }
+    if budget_deadline is not None and time.perf_counter() > budget_deadline:
+        out["static"] = {"skipped": "deadline margin exhausted"}
+        return out
+    stat = run(continuous=False)
+    out["static"] = stat
+    out["continuous_speedup"] = round(
+        cont["tokens_per_sec"] / stat["tokens_per_sec"], 2)
+    return out
+
+
 def bench_faults(steps=150, rounds=3):
     """Recovery-cost lane (fault-injection PR): what resilience costs.
 
@@ -2108,6 +2227,17 @@ def main():
             "serving": t,
         }))
         return
+    if mode == "generate":
+        t = bench_generate(budget_deadline=deadline)
+        print(json.dumps({
+            "metric": "continuous-batching generation engine "
+                      "(mixed-length streams, one compiled decode step)",
+            "value": t["continuous"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": t.get("continuous_speedup"),
+            "generate": t,
+        }))
+        return
     if mode == "serve_gateway":
         t = bench_serving_gateway()
         print(json.dumps({
@@ -2334,6 +2464,8 @@ def main():
          lambda sd: bench_bert_import_at_scale(rounds=rounds), True),
         ("serving", 50, lambda sd: bench_serving(), True),
         ("nlp", 60, lambda sd: nlp_quick(), True),
+        ("generate", 50,
+         lambda sd: bench_generate(budget_deadline=sd), True),
         ("quick_configs", 45, quick_configs, False),
         ("kernels", 60,
          lambda sd: bench_kernels(rounds=rounds, budget_deadline=sd), True),

@@ -1,0 +1,28 @@
+"""Continuous-batching text-generation engine.
+
+Autoregressive decode as a first-class serving workload: a fixed-capacity
+slot pool of per-sequence device state (KV ring buffers for causal
+transformers, layer carries for LSTM/GRU stacks), ONE compiled decode step
+replayed for the whole serving lifetime (the PyGraph lever, witnessed by
+``GenerationEngine.decode_programs``), continuous admission/retirement so
+mixed-length streams never degrade to run-to-completion batching, and
+pow2-bucketed prefill. The serving gateway streams it at
+``POST /v1/<name>/generate`` (serving/generate.py).
+
+See docs/generation.md for architecture, sampler knobs, and the slot-pool
+sizing runbook.
+"""
+
+from deeplearning4j_tpu.generation.codec import CharCodec
+from deeplearning4j_tpu.generation.engine import (
+    AttentionDecodeAdapter, GenerationEngine, GenerationRequest,
+    GenerationStream, RecurrentDecodeAdapter,
+)
+from deeplearning4j_tpu.generation.sampler import sample_keys, sample_logits
+from deeplearning4j_tpu.generation.slots import SlotPool
+
+__all__ = [
+    "AttentionDecodeAdapter", "CharCodec", "GenerationEngine",
+    "GenerationRequest", "GenerationStream", "RecurrentDecodeAdapter",
+    "SlotPool", "sample_keys", "sample_logits",
+]

@@ -1,0 +1,558 @@
+"""Continuous-batching generation engine: one compiled decode step, replayed.
+
+PyGraph (arxiv 2503.19779) frames decode latency as a LAUNCH problem: the
+per-token work is small, so the win is capturing the whole step into one
+replayable device program. The XLA analog here: a single fixed-shape jitted
+decode step — gather the slot pool, model step ``[n_slots, 1]``, seeded
+sampler, scatter state, emit tokens — whose argument shapes never change, so
+the entire serving lifetime is ONE program replay (``decode_programs``
+witnesses it; tests assert it stays 1 under churn).
+
+Two model families share the engine through small adapters:
+
+- ``RecurrentDecodeAdapter`` — LSTM/GRU/SimpleRnn stacks (zoo/textgen.py):
+  slot state is the per-layer carry dict from ``MultiLayerNetwork``'s own
+  machinery (``_init_carries`` / ``_forward_carry``), the cuDNN-persistent-
+  RNN serving story (arxiv 1410.0759) riding the fused-LSTM op tier.
+- ``AttentionDecodeAdapter`` — causal transformer stacks (zoo/bert.py
+  topology with ``causal=True``): slot state is per-layer KV ring buffers,
+  stepped through ``TransformerEncoderLayer.apply_step`` and the
+  ``cached_dot_product_attention`` op.
+
+Prefill is pow2-bucketed (``serving/warmup.py`` buckets), so prompt shapes
+compile O(log max_len) programs, not O(#lengths). Recurrent prefill uses a
+gated ``lax.scan`` — the carry stops updating once the step index passes the
+true prompt length, because right-padding WOULD corrupt an LSTM carry (every
+scan step feeds it). Attention prefill right-pads freely: under the causal
+mask, position i never sees j > i, and the pad rows written into the cache
+ring are each overwritten by the real decode step that reaches that
+position before the validity mask ever admits them.
+
+Scheduling is continuous batching: new requests are admitted into free
+slots every step and finished ones retire immediately, so throughput never
+degrades to run-to-completion of the longest sequence in a batch.
+``continuous=False`` switches to exactly that static policy — the bench A/B
+baseline (bench.py generate).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.generation.sampler import sample_keys, sample_logits
+from deeplearning4j_tpu.generation.slots import SlotPool
+from deeplearning4j_tpu.nn.layers.attention import (
+    PositionalEmbeddingLayer, TransformerEncoderLayer,
+)
+from deeplearning4j_tpu.nn.layers.core import (
+    EmbeddingLayer, EmbeddingSequenceLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import _tree_cast
+from deeplearning4j_tpu.serving.warmup import bucket_for, pow2_buckets
+
+
+# ---------------------------------------------------------------- requests
+@dataclasses.dataclass(frozen=True)
+class GenerationRequest:
+    """One decode job: prompt token ids + sampling knobs + stop conditions."""
+
+    prompt: Tuple[int, ...]
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+
+
+_DONE = object()
+
+
+class GenerationStream:
+    """Token stream for one request: iterate to receive tokens as the engine
+    emits them; iteration ends when the request finishes or is cancelled.
+    ``finish_reason`` is one of eos / length / cancelled afterwards."""
+
+    def __init__(self, request: GenerationRequest):
+        self.request = request
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._cancelled = False
+        self._last_at: Optional[float] = None
+        self._done_evt = threading.Event()
+
+    # engine side -----------------------------------------------------
+    def _emit(self, token: int) -> None:
+        self.tokens.append(token)
+        self._q.put(token)
+
+    def _finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self.finished_at = time.monotonic()
+        self._q.put(_DONE)
+        self._done_evt.set()
+
+    # consumer side ---------------------------------------------------
+    def cancel(self) -> None:
+        """Ask the engine to retire this request at its next step."""
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                return
+            yield item
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finishes (without consuming the token
+        queue); False if ``timeout`` expired first."""
+        return self._done_evt.wait(timeout)
+
+    def result(self) -> List[int]:
+        """Block until the request finishes; returns all emitted tokens."""
+        for _ in self:
+            pass
+        return self.tokens
+
+
+# ---------------------------------------------------------------- adapters
+class RecurrentDecodeAdapter:
+    """Slot state = the net's own carry dict ({layer_idx: (h, c)/(h,)}).
+
+    ``vocab`` sizes the one-hot input for raw-recurrent stacks (defaults to
+    the output layer's vocab — the char-RNN convention where input and
+    output alphabets coincide); nets whose first layer is an Embedding take
+    token indices directly and ignore it.
+    """
+
+    def __init__(self, net, vocab: Optional[int] = None):
+        self.net = net
+        self._embed_first = isinstance(
+            net.layers[0], (EmbeddingLayer, EmbeddingSequenceLayer))
+        self.vocab = vocab if vocab is not None else net.layers[-1].n_out
+
+    def init_state(self, n: int):
+        return self.net._init_carries(n)
+
+    def _encode(self, tokens):
+        """Token ids [B] -> one model input step [B, 1, ...]."""
+        if self._embed_first:
+            return tokens[:, None]
+        dt = self.net._policy.compute_dtype
+        return jax.nn.one_hot(tokens, self.vocab, dtype=dt)[:, None, :]
+
+    def decode(self, params, net_state, carries, tokens, pos):
+        """One step for every slot: logits [B, vocab] + advanced carries."""
+        net = self.net
+        cp = _tree_cast(params, net._policy.compute_dtype)
+        preout, _, _, _, new_c = net._forward_carry(
+            cp, net_state, self._encode(tokens), carries, False, None, None)
+        merged = dict(carries)
+        merged.update(new_c)
+        return preout[:, 0].astype(jnp.float32), merged
+
+    def prefill(self, params, net_state, prompt, length):
+        """Consume a padded prompt [1, Tb] into a carry for one slot. The
+        scan gate freezes the carry once the step index reaches ``length``
+        — right-pad steps MUST NOT advance a recurrent carry."""
+        net = self.net
+        cp = _tree_cast(params, net._policy.compute_dtype)
+        carries0 = self.init_state(prompt.shape[0])
+
+        def body(carries, xs):
+            tok_t, t = xs
+            _, _, _, _, new_c = net._forward_carry(
+                cp, net_state, self._encode(tok_t), carries, False, None,
+                None)
+            merged = dict(carries)
+            merged.update(new_c)
+            gate = t < length
+            return jax.tree_util.tree_map(
+                lambda o, n: jnp.where(gate, n, o), carries, merged), None
+
+        Tb = prompt.shape[1]
+        final, _ = jax.lax.scan(
+            body, carries0, (prompt.T, jnp.arange(Tb, dtype=jnp.int32)))
+        return final
+
+
+class AttentionDecodeAdapter:
+    """Slot state = per-transformer-layer KV ring buffers
+    ({layer_idx: (k, v)}, each [n_slots, n_heads, max_len, head_dim]).
+
+    Walks the net's layer list directly: Embedding -> table lookup,
+    PositionalEmbedding -> ``P[pos]`` per row, TransformerEncoderLayer ->
+    ``apply_step`` against its cache, output layer -> ``preout`` logits;
+    anything else (LayerNorm, activations) runs its normal ``apply`` on a
+    singleton time axis. Requires a causal stack — decode replays exactly
+    what the full forward would compute (tests hold it to 1e-5).
+    """
+
+    def __init__(self, net, max_len: int):
+        self.net = net
+        self.max_len = max_len
+        self._tf_layers = [i for i, l in enumerate(net.layers)
+                           if hasattr(l, "apply_step")]
+        if not self._tf_layers:
+            raise ValueError("no transformer layers with a cached-decode "
+                             "path in this network")
+        for i in self._tf_layers:
+            if not net.layers[i].causal:
+                raise ValueError(
+                    f"layer {i} is not causal=True; KV-cached decode only "
+                    "matches a causal forward")
+        for l in net.layers:
+            if isinstance(l, PositionalEmbeddingLayer) and l.max_len < max_len:
+                raise ValueError(
+                    f"engine max_len {max_len} exceeds positional table "
+                    f"({l.max_len})")
+
+    def init_state(self, n: int):
+        return {i: self.net.layers[i].init_cache(n, self.max_len)
+                for i in self._tf_layers}
+
+    def decode(self, params, net_state, caches, tokens, pos):
+        net = self.net
+        cp = _tree_cast(params, net._policy.compute_dtype)
+        x = None
+        new_caches = dict(caches)
+        last = len(net.layers) - 1
+        for i, layer in enumerate(net.layers):
+            p = cp[i]
+            if i == last and hasattr(layer, "preout"):
+                return (layer.preout(p, x[:, None, :])[:, 0].astype(
+                    jnp.float32), new_caches)
+            if isinstance(layer, (EmbeddingLayer, EmbeddingSequenceLayer)):
+                x = p["W"][tokens]
+                if layer.has_bias:
+                    x = x + p["b"]
+            elif isinstance(layer, PositionalEmbeddingLayer):
+                x = x + p["P"][pos]
+            elif hasattr(layer, "apply_step"):
+                x, new_caches[i] = layer.apply_step(p, x, caches[i], pos)
+            else:
+                y, _ = layer.apply(p, net_state[i], x[:, None, :],
+                                   train=False)
+                x = y[:, 0]
+        raise ValueError("network has no preout output layer")
+
+    def prefill(self, params, net_state, prompt, length):
+        """Causal forward over the padded prompt, harvesting each layer's
+        K/V into a fresh cache ring. ``length`` is unused: pad rows beyond
+        it land in ring positions the validity mask only admits AFTER the
+        sequential decode has overwritten them with real K/V."""
+        del length
+        net = self.net
+        cp = _tree_cast(params, net._policy.compute_dtype)
+        x = None
+        caches = {}
+        L = self.max_len
+        for i, layer in enumerate(net.layers):
+            p = cp[i]
+            if i == len(net.layers) - 1 and hasattr(layer, "preout"):
+                break
+            if isinstance(layer, (EmbeddingLayer, EmbeddingSequenceLayer)):
+                x, _ = layer.apply(p, net_state[i], prompt, train=False)
+            elif hasattr(layer, "apply_step"):
+                x, (k, v) = layer.apply_prefill(p, x)
+                ck, cv = layer.init_cache(prompt.shape[0], L, dtype=k.dtype)
+                Tb = prompt.shape[1]
+                caches[i] = (ck.at[:, :, :Tb].set(k),
+                             cv.at[:, :, :Tb].set(v))
+            else:
+                x, _ = layer.apply(p, net_state[i], x, train=False)
+        return caches
+
+
+def _auto_adapter(net, max_len: int):
+    if any(hasattr(l, "apply_step") for l in net.layers):
+        return AttentionDecodeAdapter(net, max_len)
+    if any(hasattr(l, "apply_with_carry") for l in net.layers):
+        return RecurrentDecodeAdapter(net)
+    raise ValueError("network has neither transformer apply_step nor "
+                     "recurrent apply_with_carry layers")
+
+
+# ------------------------------------------------------------------ engine
+class GenerationEngine:
+    """Continuous-batching decode over a fixed slot pool.
+
+    ``slots`` is device-resident capacity (see docs/generation.md for the
+    sizing runbook), ``max_len`` bounds prompt+generation positions (and
+    sizes the attention KV ring). ``continuous=False`` degrades scheduling
+    to static run-to-completion batching — only for A/B measurement.
+
+    Drive it synchronously (``step()``/``drain()``/``generate()``) or start
+    the background loop (``start()``) and consume ``submit()`` streams from
+    other threads — the serving gateway does the latter. Only one driver
+    may call ``step()``; ``submit()``/``cancel()`` are thread-safe.
+    """
+
+    def __init__(self, net, *, slots: int = 8, max_len: int = 128,
+                 eos_id: Optional[int] = None, continuous: bool = True,
+                 adapter=None, codec=None):
+        self.net = net
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self.continuous = continuous
+        self.codec = codec
+        self.adapter = adapter if adapter is not None else _auto_adapter(
+            net, self.max_len)
+        self.pool = SlotPool(int(slots), self.adapter.init_state)
+        self.buckets = pow2_buckets(max(1, self.max_len - 1))
+        self._decode = jax.jit(self._decode_impl)
+        self._prefill = jax.jit(self.adapter.prefill)
+        self._pending: "collections.deque[GenerationStream]" = (
+            collections.deque())
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._accepting = True
+        self.steps_run = 0
+
+    # ---------------------------------------------------- compiled pieces
+    def _decode_impl(self, params, net_state, pool_state, tokens, pos,
+                     seeds, temps, top_k, top_p):
+        logits, new_state = self.adapter.decode(
+            params, net_state, pool_state, tokens, pos)
+        keys = sample_keys(seeds, pos)
+        nxt = sample_logits(keys, logits, temperature=temps, top_k=top_k,
+                            top_p=top_p)
+        return nxt, new_state
+
+    @property
+    def decode_programs(self) -> int:
+        """Compiled decode-step count — the PyGraph witness. Stays 1 for
+        the engine's whole lifetime (fixed shapes)."""
+        return self._decode._cache_size()
+
+    @property
+    def prefill_programs(self) -> int:
+        """Compiled prefill count — bounded by the pow2 bucket list."""
+        return self._prefill._cache_size()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, prompt: Union[str, Sequence[int]], *,
+               max_new_tokens: int = 32, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> GenerationStream:
+        """Queue a request; returns its token stream immediately."""
+        if isinstance(prompt, str):
+            if self.codec is None:
+                raise ValueError("string prompt needs a codec")
+            ids = tuple(self.codec.encode(prompt))
+        else:
+            ids = tuple(int(t) for t in prompt)
+        if not ids:
+            raise ValueError("empty prompt")
+        if len(ids) > self.max_len:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds max_len {self.max_len}")
+        if (hasattr(self.adapter, "max_len")
+                and len(ids) + max_new_tokens > self.max_len):
+            # attention state is position-addressed (positional table + KV
+            # ring): the whole stream must fit; recurrent carries don't care
+            raise ValueError(
+                f"prompt + max_new_tokens = {len(ids) + max_new_tokens} "
+                f"exceeds max_len {self.max_len}")
+        req = GenerationRequest(
+            prompt=ids, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), seed=int(seed),
+            eos_id=self.eos_id if eos_id is None else eos_id)
+        stream = GenerationStream(req)
+        with self._cond:
+            if not self._accepting:
+                raise RuntimeError("engine is shut down")
+            self._pending.append(stream)
+            self._cond.notify_all()
+        return stream
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or self.pool.occupancy() > 0
+
+    def pending_count(self) -> int:
+        """Queued-but-not-yet-admitted requests (the admission-control
+        backlog signal)."""
+        return len(self._pending)
+
+    # ---------------------------------------------------------- scheduler
+    def _prefill_state(self, ids: Tuple[int, ...]):
+        n = len(ids)
+        if n == 1:
+            return self.adapter.init_state(1)
+        Tb = bucket_for(n - 1, self.buckets)
+        padded = np.zeros((1, Tb), np.int32)
+        padded[0, :n - 1] = ids[:-1]
+        return self._prefill(self.net.params, self.net.state, padded,
+                             np.int32(n - 1))
+
+    def _admit(self) -> None:
+        if not self.continuous and self.pool.occupancy() > 0:
+            return  # static batching: wait for the whole batch to finish
+        free = self.pool.free_slots()
+        while free:
+            with self._cond:
+                if not self._pending:
+                    return
+                stream = self._pending.popleft()
+            if stream.cancelled:
+                self._finish_stream(stream, "cancelled")
+                continue
+            ids = stream.request.prompt
+            t0 = time.monotonic()
+            sub = self._prefill_state(ids)
+            slot = free.pop(0)
+            req = stream.request
+            self.pool.admit(
+                slot, sub, token=ids[-1], pos=len(ids) - 1, seed=req.seed,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, meta=stream)
+            mon = monitoring.generate_monitor()
+            if mon is not None:
+                mon.prefill_seconds.observe(time.monotonic() - t0)
+
+    def _finish_stream(self, stream: GenerationStream, reason: str) -> None:
+        stream._finish(reason)
+        mon = monitoring.generate_monitor()
+        if mon is not None:
+            mon.requests_total.labels(outcome=reason).inc()
+
+    def _retire(self, slot: int, reason: str) -> None:
+        stream = self.pool.retire(slot)
+        self._finish_stream(stream, reason)
+
+    def step(self) -> bool:
+        """Admit + one decode step for the whole pool. Returns False when
+        there was nothing to do. Single-driver only."""
+        self._admit()
+        act = self.pool.active_slots()
+        mon = monitoring.generate_monitor()
+        if not act:
+            if mon is not None:
+                mon.slot_occupancy.set(0)
+            return False
+        pool = self.pool
+        nxt, pool.state = self._decode(
+            self.net.params, self.net.state, pool.state, pool.tokens,
+            pool.pos, pool.seeds, pool.temps, pool.top_k, pool.top_p)
+        nxt = np.asarray(nxt)
+        now = time.monotonic()
+        self.steps_run += 1
+        for s in act:
+            stream: GenerationStream = pool.meta[s]
+            if stream.cancelled:
+                self._retire(s, "cancelled")
+                continue
+            tok = int(nxt[s])
+            pool.pos[s] += 1
+            pool.tokens[s] = tok
+            req = stream.request
+            if req.eos_id is not None and tok == req.eos_id:
+                self._retire(s, "eos")
+                continue
+            stream._emit(tok)
+            if mon is not None:
+                if stream.first_token_at is None:
+                    mon.ttft_seconds.observe(now - stream.submitted_at)
+                elif stream._last_at is not None:
+                    mon.inter_token_seconds.observe(now - stream._last_at)
+            if stream.first_token_at is None:
+                stream.first_token_at = now
+            stream._last_at = now
+            if len(stream.tokens) >= req.max_new_tokens:
+                self._retire(s, "length")
+        if mon is not None:
+            mon.tokens_total.inc(len(act))
+            mon.decode_steps_total.inc()
+            mon.slot_occupancy.set(self.pool.occupancy())
+        return True
+
+    def drain(self, max_steps: Optional[int] = None) -> int:
+        """Synchronous driver: step until idle (or ``max_steps``)."""
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    def generate(self, prompt, **kw) -> List[int]:
+        """Convenience one-shot: submit + run to completion + tokens."""
+        stream = self.submit(prompt, **kw)
+        if self._thread is None:
+            self.drain()
+        return stream.result()
+
+    # ----------------------------------------------------- background loop
+    def start(self) -> "GenerationEngine":
+        """Run the step loop in a daemon thread (the serving mode)."""
+        if self._thread is not None:
+            return self
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="dl4j-generate", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self.has_work():
+                    self._cond.wait(timeout=0.05)
+                if not self._running and not self.has_work():
+                    return
+            self.step()
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Stop accepting, let in-flight streams finish up to ``timeout``
+        seconds, then cancel whatever remains and stop the loop."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._accepting = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            while time.monotonic() < deadline and self.has_work():
+                time.sleep(0.01)
+        else:
+            while time.monotonic() < deadline and self.has_work():
+                self.step()
+        # past the deadline: cancel stragglers
+        with self._cond:
+            pending, self._pending = list(self._pending), collections.deque()
+        for stream in pending:
+            self._finish_stream(stream, "cancelled")
+        for s in self.pool.active_slots():
+            self.pool.meta[s].cancel()
+        if self._thread is not None:
+            with self._cond:
+                self._running = False
+                self._cond.notify_all()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for s in self.pool.active_slots():
+            self._retire(s, "cancelled")

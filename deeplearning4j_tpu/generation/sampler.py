@@ -1,0 +1,72 @@
+"""Seeded token samplers for autoregressive decode.
+
+Reference analog: the dl4j-examples char-RNN sampling loop
+(GravesLSTMCharModellingExample.sampleCharactersFromNetwork: manual
+softmax-CDF walk over Nd4j.getRandom()) — here lifted into shape-static,
+jit-safe primitives so sampling lives INSIDE the one compiled decode step
+(generation/engine.py) instead of on the host between steps.
+
+Every knob is a per-row ARRAY, not a python branch: temperature <= 0 means
+greedy (argmax), top_k <= 0 and top_p >= 1 disable their filters. That keeps
+the decode program's shape signature constant no matter how requests mix
+greedy/temperature/top-k/top-p — the whole slot pool samples in one fused
+kernel, and the program compiles exactly once.
+
+Determinism: keys derive from (per-request seed, absolute position) via
+``fold_in``, so a request's token stream is a pure function of its seed and
+prompt — replayable regardless of which slot it landed in or what was
+co-batched with it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_keys(seeds, pos):
+    """Per-row PRNG keys from (request seed, absolute position) — slot- and
+    cohort-independent, so streams are replayable."""
+    return jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p))(
+        jnp.asarray(seeds, jnp.uint32), jnp.asarray(pos, jnp.int32))
+
+
+def _sample_row(key, logits, temperature, top_k, top_p):
+    """One row: greedy when temperature <= 0; else temperature-scaled
+    categorical restricted by top-k ranks and the top-p nucleus."""
+    V = logits.shape[-1]
+    f32 = logits.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+
+    scaled = f32 / jnp.maximum(temperature, 1e-6)
+    desc = jnp.sort(scaled)[::-1]
+    # top-k: keep ranks < k (k <= 0 disables). Threshold at the k-th value:
+    # ties at the boundary all stay in — a superset of k, never a subset.
+    kth = jnp.where(top_k > 0, desc[jnp.clip(top_k - 1, 0, V - 1)], neg)
+    keep = scaled >= kth
+
+    # top-p nucleus over the top-k-filtered distribution: the smallest
+    # probability-sorted prefix with cumulative mass >= p (p >= 1 disables).
+    probs = jax.nn.softmax(jnp.where(keep, scaled, neg))
+    pdesc = jnp.sort(probs)[::-1]
+    csum = jnp.cumsum(pdesc)
+    in_nucleus = (csum - pdesc) < jnp.minimum(top_p, 1.0)
+    n_keep = jnp.maximum(in_nucleus.sum(), 1)
+    pth = pdesc[n_keep - 1]
+    keep = keep & (probs >= pth)
+
+    sampled = jax.random.categorical(key, jnp.where(keep, scaled, neg))
+    return jnp.where(temperature <= 0.0, jnp.argmax(f32), sampled).astype(
+        jnp.int32)
+
+
+def sample_logits(keys, logits, *, temperature, top_k, top_p):
+    """Sample one token per row. logits [B, V]; keys [B] PRNG keys;
+    temperature/top_p [B] float32; top_k [B] int32. Shape-static — safe
+    inside a jitted decode step."""
+    B = logits.shape[0]
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+    return jax.vmap(_sample_row)(keys, logits, t, k, p)

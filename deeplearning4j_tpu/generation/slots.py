@@ -1,0 +1,91 @@
+"""Fixed-capacity slot pool: per-sequence decode state resident on device.
+
+The pool is the continuous-batching engine's memory plan: ONE device pytree
+whose every leaf has a leading ``[n_slots, ...]`` axis (KV ring buffers for
+attention models, per-layer (h, c)/(h,) carries for recurrent ones), plus a
+handful of tiny HOST-side numpy arrays (next token, absolute position,
+sampler knobs) that ride into the jitted decode step as same-shape arguments
+every call — so the step's signature, and therefore its compiled program,
+never changes across the serving lifetime.
+
+Admit/evict is row surgery on that tree, reusing the generic
+``extract_carry_rows``/``merge_carry_rows`` helpers from ``nn/multilayer.py``
+(the same machinery that backs ``rnn_set_carry_rows``). Admission always
+scatters a slot's ENTIRE state row, so nothing a retired sequence left
+behind can leak into a newcomer — witnessed by tests/test_generation.py.
+Eviction is free: the host just marks the slot inactive; the stale device
+row is dead weight until the next admit overwrites it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nn.multilayer import merge_carry_rows
+
+
+class SlotPool:
+    """``n_slots`` sequence slots: device state tree + host scheduling arrays.
+
+    ``init_state(n_slots)`` builds the zeroed device tree (every leaf
+    ``[n_slots, ...]``). Host arrays per slot: ``tokens`` (next input token),
+    ``pos`` (absolute position of that token), ``active``, and the sampler
+    knobs (``seeds``/``temps``/``top_k``/``top_p``) — all fixed-shape, so
+    passing them into the jitted decode step never retraces.
+    """
+
+    def __init__(self, n_slots: int, init_state: Callable[[int], Any]):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        self.n_slots = n_slots
+        self.state = init_state(n_slots)
+        self.tokens = np.zeros((n_slots,), np.int32)
+        self.pos = np.zeros((n_slots,), np.int32)
+        self.active = np.zeros((n_slots,), bool)
+        self.seeds = np.zeros((n_slots,), np.uint32)
+        self.temps = np.zeros((n_slots,), np.float32)
+        self.top_k = np.zeros((n_slots,), np.int32)
+        self.top_p = np.ones((n_slots,), np.float32)
+        self.meta: List[Optional[Any]] = [None] * n_slots
+        # one jitted row scatter; rows always shape [1] -> one program total
+        self._scatter = jax.jit(merge_carry_rows)
+
+    # ------------------------------------------------------------ queries
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.n_slots) if not self.active[i]]
+
+    def active_slots(self) -> List[int]:
+        return [i for i in range(self.n_slots) if self.active[i]]
+
+    def occupancy(self) -> int:
+        return int(self.active.sum())
+
+    # ----------------------------------------------------------- lifecycle
+    def admit(self, slot: int, sub_state: Any, *, token: int, pos: int,
+              seed: int, temperature: float, top_k: int, top_p: float,
+              meta: Any = None) -> None:
+        """Claim ``slot`` for a new sequence: overwrite its ENTIRE device
+        state row with ``sub_state`` (leaves ``[1, ...]``, e.g. a prefill
+        result) and set its host scheduling entries."""
+        if self.active[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        self.state = self._scatter(self.state, sub_state,
+                                   np.asarray([slot], np.int32))
+        self.tokens[slot] = token
+        self.pos[slot] = pos
+        self.seeds[slot] = np.uint32(seed)
+        self.temps[slot] = temperature
+        self.top_k[slot] = top_k
+        self.top_p[slot] = top_p
+        self.meta[slot] = meta
+        self.active[slot] = True
+
+    def retire(self, slot: int) -> Any:
+        """Release ``slot`` (host-side only — the device row is overwritten
+        by the next admit). Returns the slot's meta."""
+        meta, self.meta[slot] = self.meta[slot], None
+        self.active[slot] = False
+        return meta

@@ -48,6 +48,7 @@ _ckpt_mon = None
 _import_mon = None
 _recovery_mon = None
 _compile_mon = None
+_generate_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -75,12 +76,12 @@ def reset() -> None:
     the new registry."""
     global _REGISTRY, _tracer, _enabled
     global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
-    global _recovery_mon, _compile_mon
+    global _recovery_mon, _compile_mon, _generate_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
-    _import_mon = _recovery_mon = _compile_mon = None
+    _import_mon = _recovery_mon = _compile_mon = _generate_mon = None
 
 
 def metrics_text() -> str:
@@ -320,6 +321,42 @@ class _ImportMonitor:
             labels=("frontend", "rule"))
 
 
+class _GenerateMonitor:
+    """Generation-engine (continuous-batching decode) instruments: the
+    streaming SLO trio — time-to-first-token, inter-token latency, token
+    throughput — plus slot occupancy, decode-step count, prefill duration,
+    and ``dl4j_generate_requests_total{outcome}`` (eos / length / cancelled
+    / shed / error), so a serving incident decomposes into admission vs
+    prefill vs steady-state decode from one /metrics read."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.requests_total = reg.counter(
+            "dl4j_generate_requests_total",
+            "Finished generate requests, by outcome",
+            labels=("outcome",))
+        self.tokens_total = reg.counter(
+            "dl4j_generate_tokens_total",
+            "Tokens emitted across all streams (rate = tokens/sec)")
+        self.decode_steps_total = reg.counter(
+            "dl4j_generate_decode_steps_total",
+            "Compiled decode-step replays executed")
+        self.ttft_seconds = reg.histogram(
+            "dl4j_generate_ttft_seconds",
+            "Time from submit to a stream's first token")
+        self.inter_token_seconds = reg.histogram(
+            "dl4j_generate_inter_token_seconds",
+            "Gap between consecutive tokens of one stream",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5))
+        self.prefill_seconds = reg.histogram(
+            "dl4j_generate_prefill_seconds",
+            "Prompt prefill duration (bucketed shapes; includes compiles)")
+        self.slot_occupancy = reg.gauge(
+            "dl4j_generate_slot_occupancy",
+            "Active sequence slots after the latest decode step")
+
+
 def _bundle(cache_name: str, cls):
     if not _enabled:
         return None
@@ -360,6 +397,10 @@ def compile_monitor() -> Optional[_CompileMonitor]:
     return _bundle("_compile_mon", _CompileMonitor)
 
 
+def generate_monitor() -> Optional[_GenerateMonitor]:
+    return _bundle("_generate_mon", _GenerateMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 
 __all__ = [
@@ -369,5 +410,5 @@ __all__ = [
     "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
     "fit_monitor", "serving_monitor", "localsgd_monitor",
     "checkpoint_monitor", "import_monitor", "recovery_monitor",
-    "compile_monitor",
+    "compile_monitor", "generate_monitor",
 ]

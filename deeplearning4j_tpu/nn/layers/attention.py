@@ -155,6 +155,74 @@ class TransformerEncoderLayer(Layer):
         keep = 1.0 - self.dropout_rate
         return jnp.where(jax.random.bernoulli(rng, keep, x.shape), x / keep, 0.0).astype(x.dtype)
 
+    # ---------------------------------------------- decode (KV-cache) path
+    def _split_heads(self, t):
+        """[B, ..., N*Dh] -> [B, N, ..., Dh] (leading batch, heads axis 1)."""
+        B = t.shape[0]
+        Dh = self.d_model // self.n_heads
+        if t.ndim == 2:                       # single step [B, D]
+            return t.reshape(B, self.n_heads, Dh)
+        return t.reshape(B, t.shape[1], self.n_heads, Dh).transpose(0, 2, 1, 3)
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Per-sequence KV ring buffers for cached decode: (k, v), each
+        [batch, n_heads, max_len, head_dim]."""
+        Dh = self.d_model // self.n_heads
+        shape = (batch, self.n_heads, max_len, Dh)
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    def _mlp_half(self, x, params):
+        h = self._ln(x, params["ln2_g"], params["ln2_b"]) if self.pre_norm else x
+        m = resolve_activation(self.activation)(h @ params["W1"] + params["b1"])
+        x = x + (m @ params["W2"] + params["b2"])
+        if not self.pre_norm:
+            x = self._ln(x, params["ln2_g"], params["ln2_b"])
+        return x
+
+    def apply_step(self, params, x, cache, pos):
+        """One decode step from the KV cache: x [B, D] (the current token's
+        activations), cache (k, v) [B, N, L, Dh], pos [B] absolute positions
+        (write index = pos % L). Returns (y [B, D], new_cache). Numerically
+        identical to ``apply`` with ``causal=True`` over the full prefix —
+        the witness tests/test_generation.py holds it to 1e-5."""
+        k_cache, v_cache = cache
+        L = k_cache.shape[2]
+        B = x.shape[0]
+        h = self._ln(x, params["ln1_g"], params["ln1_b"]) if self.pre_norm else x
+        q = self._split_heads(h @ params["Wq"] + params["bq"])   # [B, N, Dh]
+        k = self._split_heads(h @ params["Wk"] + params["bk"])
+        v = self._split_heads(h @ params["Wv"] + params["bv"])
+        slot = pos % L
+        rows = jnp.arange(B)
+        k_cache = k_cache.at[rows, :, slot].set(k)
+        v_cache = v_cache.at[rows, :, slot].set(v)
+        o = op("cached_dot_product_attention")(
+            q[:, :, None, :], k_cache, v_cache, pos)               # [B,N,1,Dh]
+        o = o[:, :, 0, :].reshape(B, self.n_heads * (self.d_model // self.n_heads))
+        x = x + (o @ params["Wo"] + params["bo"])
+        if not self.pre_norm:
+            x = self._ln(x, params["ln1_g"], params["ln1_b"])
+        return self._mlp_half(x, params), (k_cache, v_cache)
+
+    def apply_prefill(self, params, x, *, mask=None):
+        """Causal forward over the whole prompt that ALSO returns the K/V
+        heads ([B, N, T, Dh] each) so the generation engine can seed a
+        slot's cache in one pass. Right-padding is safe: under the causal
+        mask, position i only ever attends to j <= i, so K/V rows below
+        the true length are exact regardless of the padding."""
+        am = _attn_mask(mask, x.shape[1], x.shape[1])
+        h = self._ln(x, params["ln1_g"], params["ln1_b"]) if self.pre_norm else x
+        q = self._split_heads(h @ params["Wq"] + params["bq"])
+        k = self._split_heads(h @ params["Wk"] + params["bk"])
+        v = self._split_heads(h @ params["Wv"] + params["bv"])
+        o = op("dot_product_attention")(q, k, v, mask=am, causal=True)
+        B, T = x.shape[0], x.shape[1]
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, -1)
+        x = x + (o @ params["Wo"] + params["bo"])
+        if not self.pre_norm:
+            x = self._ln(x, params["ln1_g"], params["ln1_b"])
+        return self._mlp_half(x, params), (k, v)
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         r1, r2 = jax.random.split(rng) if rng is not None else (None, None)
         am = _attn_mask(mask, x.shape[1], x.shape[1])

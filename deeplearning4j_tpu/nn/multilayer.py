@@ -55,6 +55,25 @@ def _check_carry_batch(carries, batch: int):
                 f"rnn_clear_previous_state() first")
 
 
+def extract_carry_rows(carries, rows):
+    """Per-row view of an rnn carry dict: {layer_idx: carry_tuple} with
+    leaves [B, ...] -> same structure with leaves [len(rows), ...].
+    ``rows`` is an int or a sequence of row indices. This is the slot-pool
+    primitive (generation/): individual sequences move in and out of a
+    pooled batch without the whole-batch "batch size changed" rejection
+    the plain rnn_time_step API keeps."""
+    idx = jnp.atleast_1d(jnp.asarray(rows, jnp.int32))
+    return jax.tree_util.tree_map(lambda a: a[idx], carries)
+
+
+def merge_carry_rows(carries, sub, rows):
+    """Inverse of :func:`extract_carry_rows`: write ``sub``'s rows (leaves
+    [len(rows), ...]) into ``carries`` at ``rows``; returns the merged
+    carry dict (functional — inputs are not mutated)."""
+    idx = jnp.atleast_1d(jnp.asarray(rows, jnp.int32))
+    return jax.tree_util.tree_map(lambda a, r: a.at[idx].set(r), carries, sub)
+
+
 def global_norm_clip(grads, max_norm):
     """DL4J GradientNormalization.ClipL2PerParamType analog (global L2 form)."""
     leaves = jax.tree_util.tree_leaves(grads)
@@ -400,6 +419,31 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         """MultiLayerNetwork.rnnClearPreviousState analog."""
         self._rnn_carries = None
+
+    def rnn_get_carry_rows(self, rows):
+        """Extract the stored rnn_time_step state for individual batch rows
+        (int or sequence) as a carry dict with leaves [len(rows), ...].
+        Raises if no state is stored yet."""
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is None:
+            raise ValueError("no stored rnn state; call rnn_time_step first")
+        return extract_carry_rows(carries, rows)
+
+    def rnn_set_carry_rows(self, rows, sub, batch: Optional[int] = None):
+        """Merge per-row carries into the stored rnn_time_step state — the
+        admit/evict half of the row API: a retiring sequence's rows can be
+        overwritten by a newcomer's without clearing the rest of the batch.
+        With no stored state, ``batch`` sizes a fresh zero carry to merge
+        into. The PLAIN rnn_time_step API keeps its whole-batch rejection;
+        this is the explicit opt-in."""
+        carries = getattr(self, "_rnn_carries", None)
+        if carries is None:
+            if batch is None:
+                raise ValueError(
+                    "no stored rnn state; pass batch= to size a fresh carry")
+            carries = self._init_carries(batch)
+        self._rnn_carries = merge_carry_rows(carries, sub, rows)
+        return self._rnn_carries
 
     def fit_batch(self, ds) -> float:
         """One optimization step on a DataSet/(features, labels) pair.

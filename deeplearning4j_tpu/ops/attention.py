@@ -47,6 +47,35 @@ def dot_product_attention(q, k, v, *, mask=None, bias=None, scale=None,
     return jnp.einsum("bnts,bnsd->bntd", w, v)
 
 
+@register_op("cached_dot_product_attention")
+def cached_dot_product_attention(q, k_cache, v_cache, pos, *, scale=None):
+    """Single-query decode attention over a KV ring buffer.
+
+    q [B, N, 1, Dh]; k_cache/v_cache [B, N, L, Dh]; pos [B] — the absolute
+    position of the query token (its k/v already written at ``pos % L`` by
+    the caller). Cache index c is valid when c <= pos (pre-wrap) or always
+    once pos >= L (ring full: the L most recent positions). Validity is a
+    SET property — with the positional signal added at the embedding, the
+    softmax is order-free, so the wrapped window needs no unwrapping.
+
+    This is the generation engine's one-compiled-decode-step workhorse: the
+    shapes never change across the serving lifetime, so the surrounding
+    step jits exactly once. The Pallas flash kernel never applies here
+    (Tq=1 is launch-bound, not memory-bound — the PyGraph lever is replay,
+    not tiling), so this op registers only the plain XLA lowering.
+    """
+    d = q.shape[-1]
+    L = k_cache.shape[2]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    logits = jnp.einsum("bntd,bnsd->bnts", q, k_cache) * scale  # [B,N,1,L]
+    valid = (jnp.arange(L)[None, :] <= pos[:, None]) | (pos[:, None] >= L)
+    neg = jnp.finfo(logits.dtype).min
+    logits = jnp.where(valid[:, None, None, :], logits, neg)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnts,bnsd->bntd", w, v_cache)
+
+
 @register_op("multi_head_attention")
 def multi_head_attention(x_q, x_kv, Wq, Wk, Wv, Wo, *, n_heads, mask=None, causal=False,
                          bq=None, bk=None, bv=None, bo=None):

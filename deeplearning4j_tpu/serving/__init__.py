@@ -18,13 +18,26 @@ Grew out of the single-model ``serving.py`` (kept importable here unchanged:
 See ``docs/serving.md`` for routes, admission knobs, and a canary example.
 """
 
-from deeplearning4j_tpu.serving.admission import AdmissionController
-from deeplearning4j_tpu.serving.gateway import ServingGateway
-from deeplearning4j_tpu.serving.http import HttpError, serve_json, _serve_json, _HttpServerMixin
-from deeplearning4j_tpu.serving.legacy import KNNServer, ModelServer
-from deeplearning4j_tpu.serving.registry import ModelRegistry, ModelVersion
-from deeplearning4j_tpu.serving.warmup import (bucket_for, pow2_buckets,
-                                               warmup_model)
+# Lazy re-exports (PEP 562): the generation engine imports
+# serving.warmup's bucket helpers, and eagerly importing the whole HTTP
+# gateway stack alongside them would drag threading servers into every
+# `import deeplearning4j_tpu.generation` (guarded by
+# tests/test_generation.py's import-graph test).
+_EXPORTS = {
+    "AdmissionController": "deeplearning4j_tpu.serving.admission",
+    "ServingGateway": "deeplearning4j_tpu.serving.gateway",
+    "HttpError": "deeplearning4j_tpu.serving.http",
+    "serve_json": "deeplearning4j_tpu.serving.http",
+    "_serve_json": "deeplearning4j_tpu.serving.http",
+    "_HttpServerMixin": "deeplearning4j_tpu.serving.http",
+    "KNNServer": "deeplearning4j_tpu.serving.legacy",
+    "ModelServer": "deeplearning4j_tpu.serving.legacy",
+    "ModelRegistry": "deeplearning4j_tpu.serving.registry",
+    "ModelVersion": "deeplearning4j_tpu.serving.registry",
+    "bucket_for": "deeplearning4j_tpu.serving.warmup",
+    "pow2_buckets": "deeplearning4j_tpu.serving.warmup",
+    "warmup_model": "deeplearning4j_tpu.serving.warmup",
+}
 
 __all__ = [
     "ServingGateway", "ModelRegistry", "ModelVersion",
@@ -32,3 +45,16 @@ __all__ = [
     "ModelServer", "KNNServer",
     "pow2_buckets", "bucket_for", "warmup_model",
 ]
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -3,6 +3,10 @@
 One HTTP server multiplexing many named, versioned models:
 
     POST /v1/<name>/predict   {"inputs": [[...]], "timeout_ms": 250}
+    POST /v1/<name>/generate  {"prompt"|"prompt_ids", sampling knobs,
+                               "stream": true} — ndjson token streaming
+                              from a continuous-batching GenerationEngine
+                              (serving/generate.py)
     POST /models/load         {"name", "version", "path", "weight",
                                "warmup_shape", "batch_limit"}
     POST /models/reload       (same body — hot swap, zero dropped requests)
@@ -36,6 +40,7 @@ import numpy as np
 
 from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.serving.admission import AdmissionController
+from deeplearning4j_tpu.serving.generate import handle_generate, match_generate
 from deeplearning4j_tpu.serving.http import (HttpError, _HttpServerMixin,
                                              serve_json)
 from deeplearning4j_tpu.serving.registry import ModelRegistry
@@ -67,7 +72,8 @@ class ServingGateway(_HttpServerMixin):
                  queue_timeout_s: float = 0.005,
                  default_timeout_s: float = 30.0,
                  retry_after_s: float = 1.0,
-                 seed: Optional[int] = None, admin: bool = True):
+                 seed: Optional[int] = None, admin: bool = True,
+                 generate_max_queue: int = 64):
         self._host, self._port = host, port
         self.admin = admin
         self.registry = ModelRegistry(
@@ -76,6 +82,8 @@ class ServingGateway(_HttpServerMixin):
         self.admission = AdmissionController(
             default_timeout_s=default_timeout_s,
             retry_after_s=retry_after_s)
+        self.generate_max_queue = generate_max_queue
+        self._generators: dict = {}
         self._draining = False
         self._inflight = 0
         self._inflight_lock = threading.Lock()
@@ -100,6 +108,18 @@ class ServingGateway(_HttpServerMixin):
     def set_split(self, name: str, weights):
         return self.registry.set_split(name, weights)
 
+    def register_generator(self, name: str, engine):
+        """Attach a started :class:`GenerationEngine` under
+        ``POST /v1/<name>/generate`` (streaming). The engine's background
+        step loop is started here if it isn't running yet."""
+        self._generators[name] = engine.start()
+        return engine
+
+    def unregister_generator(self, name: str, *, timeout: float = 10.0):
+        eng = self._generators.pop(name)
+        eng.shutdown(timeout=timeout)
+        return eng
+
     # --------------------------------------------------------- handlers
     def _track(self, delta: int):
         with self._inflight_lock:
@@ -117,6 +137,16 @@ class ServingGateway(_HttpServerMixin):
             return self._predict_inner(name, body)
         finally:
             self._track(-1)
+
+    def _generate(self, params, body):
+        if self._draining:
+            raise HttpError(503, "gateway is draining",
+                            headers=self.admission._retry_headers())
+        name = params["name"]
+        engine = self._generators.get(name)
+        if engine is None:
+            raise HttpError(404, f"generator {name!r} is not registered")
+        return handle_generate(self, engine, name, body)
 
     def _predict_inner(self, name: str, body: dict):
         try:
@@ -232,19 +262,40 @@ class ServingGateway(_HttpServerMixin):
                 "/readyz": self._readyz,
                 "/models": lambda _: {"models": self.registry.describe()},
             },
-            dynamic_post=[("/v1/*/predict", _match_predict, self._predict)])
+            dynamic_post=[
+                ("/v1/*/predict", _match_predict, self._predict),
+                ("/v1/*/generate", match_generate, self._generate),
+            ])
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0):
-        """Graceful drain: stop admitting (new predicts get 503, /readyz
-        flips), wait for in-flight HTTP requests, flush every model worker,
-        then shut the listener down. ``drain=False`` hard-stops."""
+        """Graceful drain: stop admitting (new predicts AND generates get
+        503, /readyz flips), wait for in-flight work — one-shot requests
+        and open generate streams alike, since a stream holds its in-flight
+        slot until its last token is written — then shut down. Streams
+        still open at the deadline are cancelled at their engine (the
+        terminal ndjson line says ``finish_reason: "cancelled"``), never
+        left to run headless. ``drain=False`` hard-stops."""
         self._draining = True
+        end = time.monotonic() + timeout
         if drain:
-            end = time.monotonic() + timeout
             with self._inflight_lock:
                 while self._inflight > 0:
                     remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._idle.wait(timeout=remaining)
+        for eng in self._generators.values():
+            # drain already waited on open streams; this stops the step
+            # loop and cancels anything past the deadline
+            eng.shutdown(timeout=max(0.0, end - time.monotonic())
+                         if drain else 0.0)
+        if drain:
+            # cancelled streams flush their terminal line before the
+            # listener goes away
+            with self._inflight_lock:
+                while self._inflight > 0:
+                    remaining = end + 1.0 - time.monotonic()
                     if remaining <= 0:
                         break
                     self._idle.wait(timeout=remaining)

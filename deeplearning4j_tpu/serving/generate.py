@@ -1,0 +1,127 @@
+"""The gateway's streaming text-generation tier.
+
+    POST /v1/<name>/generate   {"prompt": "..." | "prompt_ids": [...],
+                                "max_new_tokens": 64, "temperature": 0.8,
+                                "top_k": 40, "top_p": 0.95, "seed": 7,
+                                "eos_id": 3, "stream": true,
+                                "timeout_ms": 30000}
+
+Streaming mode (default) answers ndjson — one ``{"token": id, "text":
+"..."}`` line per emitted token as it is produced, then a terminal
+``{"done": true, "finish_reason": ..., "n_tokens": N}`` line (see
+serving/http.py's StreamingResponse for the wire contract). ``"stream":
+false`` collects the whole completion and answers one JSON body, bounded by
+the admission deadline (504 on expiry, partial work cancelled).
+
+Admission mirrors the predict tier: 503 while draining or after engine
+shutdown, 429 + Retry-After when the engine's backlog exceeds the queue
+bound (counted in ``dl4j_serving_shed_total{reason="queue_full"}`` and
+``dl4j_generate_requests_total{outcome="shed"}``), 404 for an unknown
+generator, 400 for a bad prompt. A client that disconnects mid-stream
+cancels its generation at the engine's next step — slots are never held by
+dead connections.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.serving.http import HttpError, StreamingResponse
+
+
+def match_generate(path: str) -> Optional[dict]:
+    """/v1/<name>/generate -> {"name": name} (None = no match)."""
+    parts = path.strip("/").split("/")
+    if len(parts) == 3 and parts[0] == "v1" and parts[2] == "generate":
+        return {"name": parts[1]}
+    return None
+
+
+def _prompt_from(body: dict, engine):
+    if "prompt_ids" in body:
+        ids = body["prompt_ids"]
+        if not isinstance(ids, (list, tuple)):
+            raise HttpError(400, "prompt_ids must be a list of token ids")
+        return [int(t) for t in ids]
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        if engine.codec is None:
+            raise HttpError(400, "this generator has no codec; send "
+                                 "prompt_ids")
+        return prompt
+    raise HttpError(400, "need prompt (string) or prompt_ids (list)")
+
+
+def handle_generate(gateway, engine, name: str, body: dict):
+    """The /v1/<name>/generate handler body, shared by the gateway.
+
+    Returns either a plain dict (one-shot) or a StreamingResponse whose
+    ``on_finish`` releases the gateway in-flight slot — which is what makes
+    ``ServingGateway.stop()`` drain streams, not just one-shot requests.
+    """
+    mon = monitoring.serving_monitor()
+    gmon = monitoring.generate_monitor()
+    if engine.pending_count() >= gateway.generate_max_queue:
+        if mon is not None:
+            mon.shed_total.labels(model=name, reason="queue_full").inc()
+        if gmon is not None:
+            gmon.requests_total.labels(outcome="shed").inc()
+        raise HttpError(429, "generation queue is full",
+                        headers=gateway.admission._retry_headers())
+    prompt = _prompt_from(body, engine)
+    try:
+        stream = engine.submit(
+            prompt,
+            max_new_tokens=int(body.get("max_new_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=int(body.get("seed", 0)),
+            eos_id=body.get("eos_id"))
+    except RuntimeError as e:  # engine shut down
+        raise HttpError(503, str(e),
+                        headers=gateway.admission._retry_headers()) from None
+    except ValueError as e:
+        raise HttpError(400, str(e)) from None
+    codec = engine.codec
+
+    if not body.get("stream", True):
+        timeout = gateway.admission.timeout_for(body)
+        if not stream.wait(timeout):
+            stream.cancel()
+            raise HttpError(504, "deadline exceeded")
+        out = {"tokens": stream.tokens, "n_tokens": len(stream.tokens),
+               "finish_reason": stream.finish_reason, "model": name}
+        if codec is not None:
+            out["text"] = codec.decode(stream.tokens)
+        return out
+
+    gateway._track(+1)
+
+    def finish():
+        if not stream.done:
+            stream.cancel()  # client went away: free the slot
+        gateway._track(-1)
+
+    def lines():
+        for tok in stream:
+            d = {"token": tok}
+            if codec is not None:
+                d["text"] = codec.decode([tok])
+            yield d
+        yield {"done": True, "finish_reason": stream.finish_reason,
+               "n_tokens": len(stream.tokens), "model": name}
+
+    return StreamingResponse(lines(), on_finish=finish)
+
+
+def read_ndjson_stream(resp):
+    """Client-side helper: iterate the parsed ndjson lines of a streaming
+    ``/generate`` response (an ``http.client``/``urllib`` response object)."""
+    import json
+
+    for raw in resp:
+        raw = raw.strip()
+        if raw:
+            yield json.loads(raw)

@@ -38,6 +38,45 @@ class HttpError(Exception):
         self.headers = dict(headers or {})
 
 
+class StreamingResponse:
+    """Marker return type for handlers that stream their response.
+
+    ``lines`` is an iterable of JSON-able dicts, written as newline-
+    delimited JSON (ndjson) with a flush per line — the client sees tokens
+    as they are produced. Delimiting is connection-close (HTTP/1.0 style):
+    no Content-Length, ``Connection: close`` — which stdlib http.client,
+    curl, and every load balancer understand without chunked-encoding
+    machinery.
+
+    ``on_finish`` runs EXACTLY once when the stream ends for any reason —
+    fully written, client disconnect, or handler error. It is where the
+    gateway releases its in-flight slot and cancels an abandoned upstream
+    generation, so graceful drain can count streams, not just one-shot
+    requests.
+    """
+
+    def __init__(self, lines, on_finish: Optional[Callable[[], None]] = None,
+                 content_type: str = "application/x-ndjson"):
+        self._lines = lines
+        self._on_finish = on_finish
+        self.content_type = content_type
+        self._finished = False
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if self._on_finish is not None:
+            self._on_finish()
+
+    def __iter__(self):
+        try:
+            for d in self._lines:
+                yield (json.dumps(d) + "\n").encode()
+        finally:
+            self.finish()
+
+
 class _HttpServerMixin:
     """Shared ephemeral-port resolution and shutdown for the HTTP servers."""
 
@@ -79,6 +118,22 @@ def serve_json(host, port, post_routes, get_routes,
             self.end_headers()
             self.wfile.write(data)
 
+        def _stream_reply(self, resp: StreamingResponse):
+            self.send_response(200)
+            self.send_header("Content-Type", resp.content_type)
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.close_connection = True
+            self.end_headers()
+            try:
+                for chunk in resp:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+            finally:
+                # client aborts surface as write errors above; either way
+                # the stream's on_finish must run (drain accounting/cancel)
+                resp.finish()
+
         def _match(self, routes, dynamic):
             path = self.path.split("?")[0]
             fn = routes.get(path)
@@ -98,11 +153,17 @@ def serve_json(host, port, post_routes, get_routes,
             mon = monitoring.serving_monitor()
             if mon is None:
                 try:
-                    self._reply(200, fn(body))
+                    payload = fn(body)
                 except HttpError as e:
                     self._reply(e.code, {"error": e.message}, e.headers)
+                    return
                 except Exception as e:  # noqa: BLE001 — serving boundary
                     self._reply(400, {"error": str(e)})
+                    return
+                if isinstance(payload, StreamingResponse):
+                    self._stream_reply(payload)
+                else:
+                    self._reply(200, payload)
                 return
             mon.in_flight.inc()
             t0 = time.perf_counter()
@@ -115,6 +176,13 @@ def serve_json(host, port, post_routes, get_routes,
                 code, payload = 400, {"error": str(e)}
             finally:
                 mon.in_flight.dec()
+            if isinstance(payload, StreamingResponse):
+                # latency for a stream is time-to-last-token, observed after
+                # the stream is fully written (or the client went away)
+                self._stream_reply(payload)
+                mon.request_seconds.labels(route=label, code=code).observe(
+                    time.perf_counter() - t0)
+                return
             mon.request_seconds.labels(route=label, code=code).observe(
                 time.perf_counter() - t0)
             self._reply(code, payload, headers)

@@ -94,6 +94,22 @@ _labels = np.eye(1000, dtype=np.float32)[_rng.integers(0, 1000, 8)]
 img_path, label_path = write_image_dataset(".", _imgs, _labels)
 n = 8
 """,
+    "generation.md": """
+from deeplearning4j_tpu.generation import CharCodec
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+codec = CharCodec("abcdefgh")
+_conf = (NeuralNetConfiguration.builder().seed(0).list()
+         .layer(LSTMLayer(n_out=8))
+         .layer(RnnOutputLayer(n_out=codec.vocab_size, activation="softmax",
+                               loss="mcxent"))
+         .set_input_type(InputType.recurrent(codec.vocab_size, 4))
+         .build())
+net = MultiLayerNetwork(_conf).init()
+""",
     "long_context.md": """
 import numpy as np
 import jax
