@@ -55,6 +55,28 @@ def _cost(compiled):
         return {}
 
 
+def _lane_cursor() -> int:
+    """Rotation cursor for the full-mode lane list, persisted IN the
+    artifact: each run prints ``lane_rotation.next_cursor`` and the next
+    run reads it back from the newest ``BENCH_r*.json`` the driver saved
+    next to this script. Rotating the starting lane across runs means a
+    tight deadline starves a DIFFERENT tail each time instead of the same
+    lanes every run (BENCH_r05 skipped 6 lanes perpetually)."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    arts = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not arts:
+        return 0
+    try:
+        with open(arts[-1], errors="replace") as f:
+            found = re.findall(r'"next_cursor":\s*(\d+)', f.read())
+        return int(found[-1]) if found else 0
+    except Exception:
+        return 0
+
+
 def _measure(step_fn, args, loss_index, warmup=2, iters=50):
     """Time ``iters`` data-dependent steps, forcing completion with a host
     fetch of the final loss.
@@ -1793,6 +1815,188 @@ def bench_generate(n_requests=48, slots=8, units=256, vocab=77,
     return out
 
 
+def bench_quantize(iters=30, budget_deadline=None):
+    """Int8 quantization lane (quantize PR): is weight-only int8 + int8 KV
+    actually buying the bandwidth it claims, and at what accuracy cost?
+
+    Two A/Bs, both against the SAME trained weights:
+      - ``predict``: a zoo.Bert-shaped encoder under the bf16 compute
+        policy, full-precision weights vs ``net.quantize()``. Reports
+        samples/sec both ways, the compiled programs' cost_analysis
+        bytes_accessed ratio (the lever being claimed: >= 1.5x fewer
+        bytes), and top-1 agreement of the output distributions.
+      - ``decode``: a char-transformer GenerationEngine, f32 KV ring vs
+        ``kv_dtype="int8"`` over the identical seeded workload. Reports
+        tokens/sec both ways, the decode step's bytes ratio, the
+        compile-counter witness (decode stays ONE program), and the
+        accuracy contract: top-1 agreement + max softmax-distribution
+        delta of int8-KV cached decode vs the f32 cached path (<= 1e-2).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.generation import GenerationEngine
+    from deeplearning4j_tpu.generation.engine import AttentionDecodeAdapter
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        EmbeddingSequenceLayer, RnnOutputLayer, TransformerEncoderLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.attention import (
+        PositionalEmbeddingLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.zoo import Bert
+
+    out = {}
+
+    # ---------------------------------------------- predict A/B (weights)
+    # serving-style small batch: per-sample weight traffic dominates, the
+    # bandwidth-bound regime the int8 pass targets (large-batch training
+    # amortizes the weight read and is NOT the claim)
+    B, T, V, C = 4, 32, 1000, 4
+    net = Bert(vocab_size=V, max_len=T, d_model=512, n_layers=4, n_heads=8,
+               d_ff=2048, num_classes=C, dropout=0.0, dtype="bf16",
+               seed=0).init()
+    qnet = net.quantize()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, V, (B, T)).astype(np.int32))
+
+    def timed(model):
+        y = model.output(ids)                      # compile + warmup
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = model.output(ids)
+        jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        fn = model._jit_cache["output"]
+        cost = _cost(fn.lower(model.params, model.state, ids,
+                              None).compile())
+        return iters * B / dt, cost, np.asarray(y)
+
+    base_sps, base_cost, yb = timed(net)
+    q_sps, q_cost, yq = timed(qnet)
+    bytes_ratio = None
+    if base_cost.get("bytes_accessed") and q_cost.get("bytes_accessed"):
+        bytes_ratio = round(base_cost["bytes_accessed"]
+                            / q_cost["bytes_accessed"], 3)
+    out["predict"] = {
+        "model": "zoo.Bert d512 L4 T32 B4 (bf16 compute)",
+        "baseline_samples_per_sec": round(base_sps, 1),
+        "int8_samples_per_sec": round(q_sps, 1),
+        "int8_speedup": round(q_sps / base_sps, 3),
+        "baseline_bytes_accessed": base_cost.get("bytes_accessed"),
+        "int8_bytes_accessed": q_cost.get("bytes_accessed"),
+        "bytes_reduction": bytes_ratio,
+        # exact storage-side reduction (cost_analysis also counts backend
+        # emulation copies — XLA:CPU materializes every convert — so the
+        # param-tree ratio is the floor-truth of what int8 removed)
+        "param_bytes_reduction": round(
+            sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(net.params))
+            / sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                  for l in jax.tree_util.tree_leaves(qnet.params)), 3),
+        "top1_agreement": round(
+            float((yb.argmax(-1) == yq.argmax(-1)).mean()), 4),
+        "max_prob_delta": round(float(np.abs(yb - yq).max()), 5),
+    }
+
+    if budget_deadline is not None and time.perf_counter() > budget_deadline:
+        out["decode"] = {"skipped": "deadline margin exhausted"}
+        return out
+
+    # ------------------------------------------------ decode A/B (KV ring)
+    # the model must be big enough that per-step weight + cache streaming
+    # dominates launch overhead, or the int8 lever has nothing to shrink
+    D, H, n_layers, vocab, max_len = 256, 8, 4, 512, 96
+    b = (NeuralNetConfiguration.builder().seed(1).list()
+         .layer(EmbeddingSequenceLayer(n_out=D, n_in=vocab))
+         .layer(PositionalEmbeddingLayer(max_len=max_len)))
+    for _ in range(n_layers):
+        b = b.layer(TransformerEncoderLayer(d_model=D, n_heads=H,
+                                            causal=True))
+    conf = (b.layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                   loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab, 16))
+            .build())
+    tnet = MultiLayerNetwork(conf).init()
+    n_req = 16
+    lens = rng.integers(4, 16, n_req)
+    news = rng.integers(12, 40, n_req)
+    prompts = [rng.integers(0, vocab, int(l)).tolist() for l in lens]
+
+    qtnet = tnet.quantize()    # int8 serving = int8 weights + int8 KV
+
+    def run_engine(model, kv_dtype):
+        eng = GenerationEngine(model, slots=8, max_len=max_len,
+                               kv_dtype=kv_dtype)
+        for p in prompts:                          # untimed compile pass
+            eng.submit(p, max_new_tokens=2)
+        eng.drain()
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new_tokens=int(news[i]),
+                              temperature=0.8, top_k=40, seed=i)
+                   for i, p in enumerate(prompts)]
+        eng.drain()
+        dt = time.perf_counter() - t0
+        total = sum(len(s.tokens) for s in streams)
+        return {"tokens_per_sec": round(total / dt, 1),
+                "decode_programs": eng.decode_programs}
+
+    f32_run = run_engine(tnet, None)
+    int8_run = run_engine(qtnet, "int8")
+
+    # accuracy contract + decode-step bytes, via the adapters directly
+    af = AttentionDecodeAdapter(tnet, max_len)
+    aq = AttentionDecodeAdapter(tnet, max_len, kv_dtype="int8")
+    Bd = 8
+    pr = jnp.asarray(rng.integers(0, vocab, (Bd, 12)))
+    length = jnp.full((Bd,), 12)
+    cf = af.prefill(tnet.params, tnet.state, pr, length)
+    cq = aq.prefill(tnet.params, tnet.state, pr, length)
+    df = jax.jit(af.decode)
+    dq = jax.jit(aq.decode)
+    toks = pr[:, -1]
+    agree, max_prob_delta, max_logit_delta = [], 0.0, 0.0
+    for t in range(11, 43):
+        pos = jnp.full((Bd,), t, jnp.int32)
+        lf, cf = df(tnet.params, tnet.state, cf, toks, pos)
+        lq, cq = dq(tnet.params, tnet.state, cq, toks, pos)
+        pf, pq = jax.nn.softmax(lf, -1), jax.nn.softmax(lq, -1)
+        max_prob_delta = max(max_prob_delta,
+                             float(jnp.abs(pf - pq).max()))
+        max_logit_delta = max(max_logit_delta,
+                              float(jnp.abs(lf - lq).max()))
+        agree.append(float((lf.argmax(-1) == lq.argmax(-1)).mean()))
+        toks = lf.argmax(-1)                       # same token feed to both
+    cost_f = _cost(df.lower(tnet.params, tnet.state, cf, toks,
+                            pos).compile())
+    # bytes of the FULL int8 path (int8 weights + int8 KV), matching the
+    # engine A/B above
+    afull = AttentionDecodeAdapter(qtnet, max_len, kv_dtype="int8")
+    cfull = afull.prefill(qtnet.params, qtnet.state, pr, length)
+    cost_q = _cost(jax.jit(afull.decode).lower(
+        qtnet.params, qtnet.state, cfull, toks, pos).compile())
+    kv_bytes_ratio = None
+    if cost_f.get("bytes_accessed") and cost_q.get("bytes_accessed"):
+        kv_bytes_ratio = round(cost_f["bytes_accessed"]
+                               / cost_q["bytes_accessed"], 3)
+    out["decode"] = {
+        "model": f"char-transformer d{D} L{n_layers} vocab {vocab}",
+        "f32_kv": f32_run,
+        "int8_kv": int8_run,
+        "int8_speedup": round(int8_run["tokens_per_sec"]
+                              / f32_run["tokens_per_sec"], 3),
+        "decode_step_bytes_reduction": kv_bytes_ratio,
+        "top1_agreement": round(float(np.mean(agree)), 4),
+        "max_prob_delta": round(max_prob_delta, 5),
+        "max_logit_delta": round(max_logit_delta, 5),
+    }
+    return out
+
+
 def bench_faults(steps=150, rounds=3):
     """Recovery-cost lane (fault-injection PR): what resilience costs.
 
@@ -2238,6 +2442,17 @@ def main():
             "generate": t,
         }))
         return
+    if mode == "quantize":
+        t = bench_quantize(budget_deadline=deadline)
+        print(json.dumps({
+            "metric": "int8 quantization A/B (weight-only predict + "
+                      "int8-KV decode vs full precision)",
+            "value": t["predict"].get("int8_speedup"),
+            "unit": "x samples/sec vs bf16",
+            "vs_baseline": t["predict"].get("bytes_reduction"),
+            "quantize": t,
+        }))
+        return
     if mode == "serve_gateway":
         t = bench_serving_gateway()
         print(json.dumps({
@@ -2473,8 +2688,15 @@ def main():
         # "deadline margin exhausted" because it only ran on leftovers;
         # 75s matches bert_import's reservation and covers the dispatch A/B
         ("input_pipeline", 75, pipe_block, True),
+        ("quantize", 50,
+         lambda sd: bench_quantize(budget_deadline=sd), True),
         ("remeasure", 30, remeasure_block, False),
     ]
+    # rotate the starting lane by the cursor persisted in the previous
+    # run's artifact, so deadline starvation lands on a different tail
+    # each run; every lane still keeps its own min-slice reservation
+    cursor = _lane_cursor() % len(lanes)
+    lanes = lanes[cursor:] + lanes[:cursor]
     planned = [name for name, _, _, _ in lanes]
     ran, skipped = [], {}
     for idx, (name, min_secs, fn, record_error) in enumerate(lanes):
@@ -2499,6 +2721,10 @@ def main():
     result["planned_vs_run"] = {
         "planned": planned, "ran": ran, "skipped": skipped,
         "lane_min_secs": {name: m for name, m, _, _ in lanes}}
+    result["lane_rotation"] = {
+        "cursor": cursor,
+        "next_cursor": (cursor + 1) % len(lanes),
+        "order": planned}
     print(json.dumps(result))
 
 

@@ -211,9 +211,12 @@ class AttentionDecodeAdapter:
     what the full forward would compute (tests hold it to 1e-5).
     """
 
-    def __init__(self, net, max_len: int):
+    def __init__(self, net, max_len: int, kv_dtype: Optional[str] = None):
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r}")
         self.net = net
         self.max_len = max_len
+        self.kv_dtype = kv_dtype
         self._tf_layers = [i for i, l in enumerate(net.layers)
                            if hasattr(l, "apply_step")]
         if not self._tf_layers:
@@ -231,7 +234,8 @@ class AttentionDecodeAdapter:
                     f"({l.max_len})")
 
     def init_state(self, n: int):
-        return {i: self.net.layers[i].init_cache(n, self.max_len)
+        return {i: self.net.layers[i].init_cache(n, self.max_len,
+                                                 kv_dtype=self.kv_dtype)
                 for i in self._tf_layers}
 
     def decode(self, params, net_state, caches, tokens, pos):
@@ -280,16 +284,29 @@ class AttentionDecodeAdapter:
                 x, (k, v) = layer.apply_prefill(p, x)
                 ck, cv = layer.init_cache(prompt.shape[0], L, dtype=k.dtype)
                 Tb = prompt.shape[1]
-                caches[i] = (ck.at[:, :, :Tb].set(k),
-                             cv.at[:, :, :Tb].set(v))
+                ck = ck.at[:, :, :Tb].set(k)
+                cv = cv.at[:, :, :Tb].set(v)
+                if self.kv_dtype == "int8":
+                    # quantize the whole seeded ring in one pass; the
+                    # running absmax scale then only grows during decode
+                    from deeplearning4j_tpu.quantize.kvcache import (
+                        quantize_cache)
+                    qk, sk = quantize_cache(ck)
+                    qv, sv = quantize_cache(cv)
+                    caches[i] = (qk, qv, sk, sv)
+                else:
+                    caches[i] = (ck, cv)
             else:
                 x, _ = layer.apply(p, net_state[i], x, train=False)
         return caches
 
 
-def _auto_adapter(net, max_len: int):
+def _auto_adapter(net, max_len: int, kv_dtype: Optional[str] = None):
     if any(hasattr(l, "apply_step") for l in net.layers):
-        return AttentionDecodeAdapter(net, max_len)
+        return AttentionDecodeAdapter(net, max_len, kv_dtype=kv_dtype)
+    if kv_dtype is not None:
+        raise ValueError("kv_dtype requires attention layers with a "
+                         "KV-cached decode path")
     if any(hasattr(l, "apply_with_carry") for l in net.layers):
         return RecurrentDecodeAdapter(net)
     raise ValueError("network has neither transformer apply_step nor "
@@ -313,14 +330,17 @@ class GenerationEngine:
 
     def __init__(self, net, *, slots: int = 8, max_len: int = 128,
                  eos_id: Optional[int] = None, continuous: bool = True,
-                 adapter=None, codec=None):
+                 adapter=None, codec=None, kv_dtype: Optional[str] = None):
         self.net = net
         self.max_len = int(max_len)
         self.eos_id = eos_id
         self.continuous = continuous
         self.codec = codec
+        if adapter is not None and kv_dtype is not None:
+            raise ValueError("pass kv_dtype to the adapter OR let the "
+                             "engine build one, not both")
         self.adapter = adapter if adapter is not None else _auto_adapter(
-            net, self.max_len)
+            net, self.max_len, kv_dtype=kv_dtype)
         self.pool = SlotPool(int(slots), self.adapter.init_state)
         self.buckets = pow2_buckets(max(1, self.max_len - 1))
         self._decode = jax.jit(self._decode_impl)

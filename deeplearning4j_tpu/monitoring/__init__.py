@@ -49,6 +49,7 @@ _import_mon = None
 _recovery_mon = None
 _compile_mon = None
 _generate_mon = None
+_quantize_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -76,12 +77,13 @@ def reset() -> None:
     the new registry."""
     global _REGISTRY, _tracer, _enabled
     global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
-    global _recovery_mon, _compile_mon, _generate_mon
+    global _recovery_mon, _compile_mon, _generate_mon, _quantize_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
     _import_mon = _recovery_mon = _compile_mon = _generate_mon = None
+    _quantize_mon = None
 
 
 def metrics_text() -> str:
@@ -357,6 +359,42 @@ class _GenerateMonitor:
             "Active sequence slots after the latest decode step")
 
 
+class _QuantizeMonitor:
+    """Quantization-tier instruments: each ``quantize_network`` pass records
+    how many weight tensors moved to int8, the param-tree footprint before
+    and after (the bandwidth lever being claimed), and the pass duration —
+    so a serving fleet's /metrics shows whether a loaded model is actually
+    running the shrunk weights it was asked to."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.passes_total = reg.counter(
+            "dl4j_quantize_passes_total",
+            "Post-training quantization passes run, by target dtype",
+            labels=("dtype",))
+        self.tensors_total = reg.counter(
+            "dl4j_quantize_tensors_total",
+            "Weight tensors converted across all passes")
+        self.bytes_before = reg.gauge(
+            "dl4j_quantize_bytes_before",
+            "Param-tree bytes of the last pass's input network")
+        self.bytes_after = reg.gauge(
+            "dl4j_quantize_bytes_after",
+            "Param-tree bytes of the last pass's quantized view")
+        self.pass_seconds = reg.histogram(
+            "dl4j_quantize_pass_seconds",
+            "Quantization pass duration",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0))
+
+    def observe_pass(self, *, dtype, tensors, bytes_before, bytes_after,
+                     seconds):
+        self.passes_total.labels(dtype=dtype).inc()
+        self.tensors_total.inc(tensors)
+        self.bytes_before.set(bytes_before)
+        self.bytes_after.set(bytes_after)
+        self.pass_seconds.observe(seconds)
+
+
 def _bundle(cache_name: str, cls):
     if not _enabled:
         return None
@@ -401,6 +439,10 @@ def generate_monitor() -> Optional[_GenerateMonitor]:
     return _bundle("_generate_mon", _GenerateMonitor)
 
 
+def quantize_monitor() -> Optional[_QuantizeMonitor]:
+    return _bundle("_quantize_mon", _QuantizeMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 
 __all__ = [
@@ -410,5 +452,5 @@ __all__ = [
     "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
     "fit_monitor", "serving_monitor", "localsgd_monitor",
     "checkpoint_monitor", "import_monitor", "recovery_monitor",
-    "compile_monitor", "generate_monitor",
+    "compile_monitor", "generate_monitor", "quantize_monitor",
 ]

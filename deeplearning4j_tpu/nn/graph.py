@@ -487,6 +487,10 @@ class ComputationGraph:
         """One optimization step. Sync mode returns the loss as a float;
         async mode (optimize/async_dispatch, the default) returns a lazy
         ScoreHandle — see MultiLayerNetwork.fit_batch."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network is an int8 inference view (quantize()); "
+                "train the original f32 network instead")
         x, y, mask, label_mask = _unpack(ds)
         if env.pad_tail and not isinstance(y, (list, tuple, dict)):
             # pad partial epoch tails up to a pow2 bucket (loss-exact via
@@ -628,6 +632,14 @@ class ComputationGraph:
         return float(fn(self.params, self.state, inputs, labels,
                         None if mask is None else [jnp.asarray(mask)],
                         labels_masks))
+
+    # ------------------------------------------------------------- quantize
+    def quantize(self, dtype: str = "int8") -> "ComputationGraph":
+        """Weight-only int8 inference view of this graph (the original
+        stays trainable). See deeplearning4j_tpu.quantize."""
+        from deeplearning4j_tpu.quantize import quantize_network
+
+        return quantize_network(self, dtype)
 
     # ----------------------------------------------------------------- serde
     def save(self, path: str, save_updater: bool = True):

@@ -164,11 +164,20 @@ class TransformerEncoderLayer(Layer):
             return t.reshape(B, self.n_heads, Dh)
         return t.reshape(B, t.shape[1], self.n_heads, Dh).transpose(0, 2, 1, 3)
 
-    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32,
+                   kv_dtype=None):
         """Per-sequence KV ring buffers for cached decode: (k, v), each
-        [batch, n_heads, max_len, head_dim]."""
+        [batch, n_heads, max_len, head_dim]. With ``kv_dtype="int8"`` the
+        buffers are int8 and the cache is the 4-tuple (k, v, k_scale,
+        v_scale) with per-(row, head) running absmax scales."""
         Dh = self.d_model // self.n_heads
         shape = (batch, self.n_heads, max_len, Dh)
+        if kv_dtype == "int8":
+            return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                    jnp.zeros((batch, self.n_heads), jnp.float32),
+                    jnp.zeros((batch, self.n_heads), jnp.float32))
+        if kv_dtype is not None:
+            raise ValueError(f"unsupported kv_dtype {kv_dtype!r}")
         return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
     def _mlp_half(self, x, params):
@@ -184,8 +193,17 @@ class TransformerEncoderLayer(Layer):
         activations), cache (k, v) [B, N, L, Dh], pos [B] absolute positions
         (write index = pos % L). Returns (y [B, D], new_cache). Numerically
         identical to ``apply`` with ``causal=True`` over the full prefix —
-        the witness tests/test_generation.py holds it to 1e-5."""
-        k_cache, v_cache = cache
+        the witness tests/test_generation.py holds it to 1e-5.
+
+        The cache may also be the int8 4-tuple from ``init_cache(...,
+        kv_dtype="int8")``; the ring write then quantizes in place against
+        per-(row, head) running absmax scales and the attention op
+        dequantizes on its accumulator outputs."""
+        int8_mode = len(cache) == 4
+        if int8_mode:
+            k_cache, v_cache, k_sc, v_sc = cache
+        else:
+            k_cache, v_cache = cache
         L = k_cache.shape[2]
         B = x.shape[0]
         h = self._ln(x, params["ln1_g"], params["ln1_b"]) if self.pre_norm else x
@@ -194,15 +212,25 @@ class TransformerEncoderLayer(Layer):
         v = self._split_heads(h @ params["Wv"] + params["bv"])
         slot = pos % L
         rows = jnp.arange(B)
-        k_cache = k_cache.at[rows, :, slot].set(k)
-        v_cache = v_cache.at[rows, :, slot].set(v)
-        o = op("cached_dot_product_attention")(
-            q[:, :, None, :], k_cache, v_cache, pos)               # [B,N,1,Dh]
+        if int8_mode:
+            from deeplearning4j_tpu.quantize.kvcache import ring_write_quantized
+            k_cache, k_sc = ring_write_quantized(k_cache, k_sc, k, rows, slot)
+            v_cache, v_sc = ring_write_quantized(v_cache, v_sc, v, rows, slot)
+            o = op("cached_dot_product_attention")(
+                q[:, :, None, :], k_cache, v_cache, pos,
+                k_scale=k_sc, v_scale=v_sc)                        # [B,N,1,Dh]
+            new_cache = (k_cache, v_cache, k_sc, v_sc)
+        else:
+            k_cache = k_cache.at[rows, :, slot].set(k)
+            v_cache = v_cache.at[rows, :, slot].set(v)
+            o = op("cached_dot_product_attention")(
+                q[:, :, None, :], k_cache, v_cache, pos)           # [B,N,1,Dh]
+            new_cache = (k_cache, v_cache)
         o = o[:, :, 0, :].reshape(B, self.n_heads * (self.d_model // self.n_heads))
         x = x + (o @ params["Wo"] + params["bo"])
         if not self.pre_norm:
             x = self._ln(x, params["ln1_g"], params["ln1_b"])
-        return self._mlp_half(x, params), (k_cache, v_cache)
+        return self._mlp_half(x, params), new_cache
 
     def apply_prefill(self, params, x, *, mask=None):
         """Causal forward over the whole prompt that ALSO returns the K/V

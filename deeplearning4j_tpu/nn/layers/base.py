@@ -95,6 +95,9 @@ class Layer:
         for k, v in params.items():
             if k in ("b", "beta", "gamma"):  # DL4J: no l1/l2 on bias by default
                 continue
+            if getattr(v, "is_quantized", False):
+                # quantized inference view: frozen weights carry no penalty
+                continue
             if isinstance(v, dict):
                 s = s + sum(self.l1 * jnp.abs(a).sum() + self.l2 * 0.5 * (a * a).sum()
                             for a in jax.tree_util.tree_leaves(v))

@@ -69,10 +69,21 @@ class ConvolutionLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self._maybe_dropout(x, train, rng)
-        y = op("conv2d")(
-            x, params["W"], strides=_t2(self.strides), padding=self.padding,
-            dilation=_t2(self.dilation), groups=self.groups,
-        )
+        W = params["W"]
+        if getattr(W, "is_quantized", False):
+            # int8 view: convolve the int8 kernel (convert fuses into the
+            # conv's operand read) and scale the per-channel OUTPUT — the
+            # kernel's output-channel axis is last, same as the result's
+            y = op("conv2d")(
+                x, W.q.astype(x.dtype), strides=_t2(self.strides),
+                padding=self.padding, dilation=_t2(self.dilation),
+                groups=self.groups,
+            ) * W.scale.astype(x.dtype)
+        else:
+            y = op("conv2d")(
+                x, W, strides=_t2(self.strides), padding=self.padding,
+                dilation=_t2(self.dilation), groups=self.groups,
+            )
         if self.has_bias:
             y = y + params["b"]
         return resolve_activation(self.activation)(y), state

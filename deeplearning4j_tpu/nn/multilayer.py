@@ -453,6 +453,10 @@ class MultiLayerNetwork:
         Async mode (the default) returns a lazy ScoreHandle and keeps up to
         ``DL4J_TPU_ASYNC_STEPS`` steps in flight; any numeric use of the
         handle (or reading ``score()``) drains to a float."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this network is an int8 inference view (quantize()); "
+                "train the original f32 network instead")
         x, y, mask, label_mask = _unpack(ds)
         label_mask = _single_mask(label_mask)
         if (self.conf.tbptt_fwd_length > 0 and np.ndim(x) == 3
@@ -698,6 +702,14 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         return ev
+
+    # ------------------------------------------------------------- quantize
+    def quantize(self, dtype: str = "int8") -> "MultiLayerNetwork":
+        """Weight-only int8 inference view of this network (the original
+        stays trainable). See deeplearning4j_tpu.quantize."""
+        from deeplearning4j_tpu.quantize import quantize_network
+
+        return quantize_network(self, dtype)
 
     # ----------------------------------------------------------------- serde
     def save(self, path: str, save_updater: bool = True):

@@ -19,7 +19,7 @@ from deeplearning4j_tpu.ops.registry import (
 # must load BEFORE the pallas kernels register over them — an accelerated
 # impl without its reference would make registry fallback a KeyError
 from deeplearning4j_tpu.ops import (  # noqa: F401
-    activations, attention, convolution, losses, recurrent, rng,
+    activations, attention, convolution, losses, quantized, recurrent, rng,
 )
 from deeplearning4j_tpu.ops import pallas  # noqa: F401  (register accelerated kernels)
 

@@ -48,7 +48,8 @@ def dot_product_attention(q, k, v, *, mask=None, bias=None, scale=None,
 
 
 @register_op("cached_dot_product_attention")
-def cached_dot_product_attention(q, k_cache, v_cache, pos, *, scale=None):
+def cached_dot_product_attention(q, k_cache, v_cache, pos, *, scale=None,
+                                 k_scale=None, v_scale=None):
     """Single-query decode attention over a KV ring buffer.
 
     q [B, N, 1, Dh]; k_cache/v_cache [B, N, L, Dh]; pos [B] — the absolute
@@ -63,17 +64,30 @@ def cached_dot_product_attention(q, k_cache, v_cache, pos, *, scale=None):
     step jits exactly once. The Pallas flash kernel never applies here
     (Tq=1 is launch-bound, not memory-bound — the PyGraph lever is replay,
     not tiling), so this op registers only the plain XLA lowering.
+
+    Int8 cache mode: the caches may be int8 with per-(batch, head) absmax
+    scales ``k_scale``/``v_scale`` [B, N]. Because the scale is constant
+    over both the sequence axis and the head dim, dequantization commutes
+    out of the contractions: ``k_scale`` multiplies the logits and
+    ``v_scale`` the output — exact w.r.t. the dequantized cache, without
+    ever materializing it.
     """
     d = q.shape[-1]
     L = k_cache.shape[2]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(
         jnp.asarray(d, q.dtype))
-    logits = jnp.einsum("bntd,bnsd->bnts", q, k_cache) * scale  # [B,N,1,L]
+    logits = jnp.einsum("bntd,bnsd->bnts", q,
+                        k_cache.astype(q.dtype)) * scale  # [B,N,1,L]
+    if k_scale is not None:
+        logits = logits * k_scale.astype(q.dtype)[:, :, None, None]
     valid = (jnp.arange(L)[None, :] <= pos[:, None]) | (pos[:, None] >= L)
     neg = jnp.finfo(logits.dtype).min
     logits = jnp.where(valid[:, None, None, :], logits, neg)
     w = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bnts,bnsd->bntd", w, v_cache)
+    out = jnp.einsum("bnts,bnsd->bntd", w, v_cache.astype(q.dtype))
+    if v_scale is not None:
+        out = out * v_scale.astype(q.dtype)[:, :, None, None]
+    return out
 
 
 @register_op("multi_head_attention")

@@ -448,7 +448,10 @@ def _gru_applicable(x, h0, W, R, b, **kw):
     when R is grid-invariant (one hidden tile spans H, fetched once, the
     recurrence fully VMEM-resident) — which r4's batch-blocked grid now
     achieves at large B too. Verified by the bench `kernels` mode A/B
-    rows."""
+    rows. Non-f32/bf16 dtypes stay on the XLA scan — the A/B evidence
+    and the MXU panel layout cover only those."""
+    if x.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
     Hp = _pad_to_lanes(R.shape[0])
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
     return (x.shape[0] % 8 == 0

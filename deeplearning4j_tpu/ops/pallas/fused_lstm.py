@@ -684,7 +684,11 @@ def _lstm_applicable(x, h0, c0, W, R, b, *, peephole=None, **kw):
     blocks (Bc=64 infer / Bc=32 train) and the bwd runs (64, 512),
     measured 1.10x fwd / 1.33x train — numbers in BASELINE.md. Only
     shapes with no resident plan at all (H too big for any block to keep
-    R in VMEM, e.g. H >= 2048) stay on the XLA scan."""
+    R in VMEM, e.g. H >= 2048) stay on the XLA scan, as do non-f32/bf16
+    dtypes — the measured A/B evidence (and the MXU panel layout) covers
+    only those."""
+    if x.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
     Hp = _pad_to_lanes(R.shape[0])         # unaligned H runs zero-padded
     rb = jnp.dtype(_panel_dtype(R.dtype)).itemsize
     return (x.shape[0] % 8 == 0

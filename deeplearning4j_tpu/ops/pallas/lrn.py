@@ -162,10 +162,13 @@ def _lrn_applicable(x, *, depth=5, **kw):
     shape [64,27,27,256]): fwd 1.26x, train 1.47x. The r3 demotion (train
     0.45x) was caused by the backward recomputing through the XLA lowering
     — the grad path paid kernel-fwd PLUS a full XLA fwd+bwd; the r4 banded
-    backward kernel (_lrn_bwd_kernel) removed that tax. The structural
-    requires() bounds (enough rows to fill blocks, band fits VMEM) are the
-    only remaining gate."""
-    return True
+    backward kernel (_lrn_bwd_kernel) removed that tax. Beyond the
+    structural requires() bounds (enough rows to fill blocks, band fits
+    VMEM), the only gate is dtype: the A/B evidence covers f32/bf16 — the
+    MXU-native dtypes the band contraction was tuned for — so anything
+    else (f64 emulation, exotic inputs) stays on the measured-safe XLA
+    lowering."""
+    return x.dtype in (jnp.float32, jnp.bfloat16)
 
 
 register_impl("lrn", platform="pallas", predicate=_lrn_applicable,

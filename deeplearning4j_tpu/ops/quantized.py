@@ -1,0 +1,54 @@
+"""Registry ops for int8 weight-only matmuls.
+
+Both ops take the activation ``x`` plus the *decomposed* quantized weight
+(``q`` int8, ``scale`` f32 per output channel) rather than a wrapper object,
+so the registry's predicate machinery sees plain arrays and alternate
+backends can register accelerated impls per platform.
+
+The contract that makes weight-only quantization a bandwidth win: the int8
+payload is the only full-size weight buffer. ``q.astype(x.dtype)`` is a
+convert feeding straight into the dot — XLA fuses it into the matmul's
+operand read, so no dequantized copy lands in HBM — and the scale is applied
+to the accumulator OUTPUT (activation-sized), never to the weight. The
+tier-1 jaxpr witness (``quantize.witness``) checks exactly this: no ``mul``
+equation may produce a float array of the weight's full shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import register_op
+
+
+@register_op("quantized_matmul")
+def quantized_matmul(x, q, scale):
+    """``x @ (q * scale)`` computed as ``(x @ q) * scale``.
+
+    x: [..., K] activation (f32/bf16); q: [K, N] int8; scale: [N] f32.
+    Exact w.r.t. the dequantized weight: the scale is constant along the
+    contracted axis, so it commutes out of the dot.
+    """
+    acc = jnp.matmul(x, q.astype(x.dtype))
+    return acc * scale.astype(x.dtype)
+
+
+@register_op("quantized_einsum")
+def quantized_einsum(spec, x, q, scale):
+    """Einsum with an int8 weight whose quantized (output-channel) axis is
+    the LAST axis of both ``q`` and the result, so the [N] scale broadcasts
+    onto the accumulator output.
+
+    spec: einsum equation, e.g. ``"btd,dn->btn"``; the weight is the second
+    operand. The quantized axis must appear in the output (not be
+    contracted) and be trailing in both — that is what makes pulling the
+    scale out of the contraction exact.
+    """
+    out_sub = spec.split("->")[-1].strip()
+    w_sub = spec.split("->")[0].split(",")[1].strip()
+    if not out_sub or w_sub[-1] != out_sub[-1]:
+        raise ValueError(
+            f"quantized_einsum needs the weight's last axis to be the "
+            f"result's last axis (got spec {spec!r})")
+    acc = jnp.einsum(spec, x, q.astype(x.dtype))
+    return acc * scale.astype(x.dtype)

@@ -1,0 +1,46 @@
+"""Int8 quantization subsystem: weight-only serving + quantized KV cache.
+
+Motivation (BENCH_r05): the hot serving paths are HBM-bandwidth-bound, not
+compute-bound — bert_import loses 1.62x in *bytes* at matched FLOPs, and
+continuous-batching decode re-reads every weight and the whole KV cache for
+one token per slot per step. The classic primitives-level answer (cuDNN,
+arxiv 1410.0759; Dragon-Alpha, arxiv 2305.08819) is to shrink the bytes the
+memory system must move per op. Two independent levers here:
+
+- **Weight-only int8** (``quantize_network`` / ``net.quantize()``): a
+  post-training pass replaces dense/conv/attention projection weights with
+  :class:`QuantizedTensor` (int8 payload + per-output-channel f32 absmax
+  scales). Matmuls route through the ``quantized_matmul`` /
+  ``quantized_einsum`` registry ops, which apply the scale to the f32/bf16
+  accumulator OUTPUT — a full-size dequantized weight buffer is never
+  materialized (``witness.assert_no_dequantized_weights`` guards it in
+  tier 1).
+- **Int8 KV cache** (``AttentionDecodeAdapter(..., kv_dtype="int8")``):
+  per-head running absmax scales, quantize on ring-write at ``pos %
+  max_len``, dequantize inside ``cached_dot_product_attention`` — halving
+  steady-state decode cache traffic.
+
+Accuracy contract (held by tests + the ``bench.py quantize`` lane): top-1
+logits agreement >= 99% for weight-only int8 predict, and int8-KV cached
+decode logits within 1e-2 of the f32 cached path.
+"""
+
+from deeplearning4j_tpu.quantize.tensor import (
+    QuantizedTensor, dequantize_tensor, quantize_tensor,
+)
+from deeplearning4j_tpu.quantize.passes import (
+    QUANT_RULES, quantize_params, quantize_network,
+)
+from deeplearning4j_tpu.quantize.kvcache import (
+    quantize_cache, ring_write_quantized,
+)
+from deeplearning4j_tpu.quantize.witness import (
+    assert_no_dequantized_weights, find_dequantized_weights,
+)
+
+__all__ = [
+    "QuantizedTensor", "quantize_tensor", "dequantize_tensor",
+    "QUANT_RULES", "quantize_params", "quantize_network",
+    "quantize_cache", "ring_write_quantized",
+    "assert_no_dequantized_weights", "find_dequantized_weights",
+]

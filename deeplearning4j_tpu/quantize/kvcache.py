@@ -1,0 +1,62 @@
+"""Int8 KV-cache helpers for ring-buffer decode.
+
+The decode KV cache is re-read in full every step, so its bytes dominate
+steady-state decode traffic. Int8 mode stores each layer's (K, V) as int8
+with ONE running absmax scale per (batch row, head): per-head scales keep
+dequantization exact to pull outside the attention contractions (the scale
+is constant over both the sequence axis and the head dim), so
+``cached_dot_product_attention`` can apply ``k_scale`` to the logits and
+``v_scale`` to the output without ever materializing a dequantized cache.
+
+The scale only ever grows (running max). When a new vector raises it, the
+already-written int8 rows are requantized by the ratio ``old/new`` in a
+fused elementwise pass over the cache — exact no-op (ratio 1) on the common
+step where the max is unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-8
+
+
+def quantize_cache(cache, pos_axis: int = 2):
+    """Quantize a filled f32/bf16 cache [B, N, L, Dh] to (int8 cache,
+    per-(B, N) scale). Used at prefill time, when the whole prefix is
+    available at once."""
+    absmax = jnp.max(jnp.abs(cache.astype(jnp.float32)),
+                     axis=(pos_axis, cache.ndim - 1))
+    scale = jnp.maximum(absmax / 127.0, _EPS)
+    s = scale[:, :, None, None]
+    q = jnp.clip(jnp.round(cache.astype(jnp.float32) / s), -127,
+                 127).astype(jnp.int8)
+    return q, scale
+
+
+def ring_write_quantized(cache_q, scale, new, rows, slot):
+    """One decode step's ring write for an int8 cache.
+
+    cache_q: [B, N, L, Dh] int8; scale: [B, N] f32 (running absmax / 127);
+    new: [B, N, Dh] the step's K or V vector; rows: [B] batch indices;
+    slot: [B] ring slot (``pos % L``). Returns (new cache_q, new scale).
+    """
+    new = new.astype(jnp.float32)
+    step_max = jnp.max(jnp.abs(new), axis=-1)  # [B, N]
+    new_scale = jnp.maximum(scale, jnp.maximum(step_max / 127.0, _EPS))
+
+    # shrink existing rows into the (possibly) larger range — but only
+    # when some scale actually grew: after warm-up the running max is
+    # stable, so the cond takes the identity branch and the steady-state
+    # step never streams the cache through a requant pass
+    def _requant(c):
+        ratio = (scale / new_scale)[:, :, None, None]
+        return jnp.clip(jnp.round(c.astype(jnp.float32) * ratio),
+                        -127, 127).astype(jnp.int8)
+
+    cache_q = jax.lax.cond(jnp.any(new_scale > scale), _requant,
+                           lambda c: c, cache_q)
+    q_new = jnp.clip(jnp.round(new / new_scale[:, :, None]), -127,
+                     127).astype(jnp.int8)
+    return cache_q.at[rows, :, slot].set(q_new), new_scale

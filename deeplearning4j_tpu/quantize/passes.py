@@ -1,0 +1,123 @@
+"""Post-training weight-only int8 pass over a trained network.
+
+``QUANT_RULES`` is the whitelist: per layer CLASS, which param-table keys
+are quantizable and along which axis the output channels run. Everything
+else — biases, norms, recurrent matrices (sequential error feedback makes
+them accuracy-fragile), embeddings of the f32 path — stays untouched.
+Matching is on exact class name, so subclasses with different numerics
+(e.g. CenterLossOutputLayer) opt in explicitly or not at all.
+
+``quantize_network`` produces an INFERENCE VIEW: a shallow copy of the net
+sharing config (params/state buffers are owned copies — the original's
+training steps donate theirs to XLA), with whitelisted weights replaced by
+:class:`QuantizedTensor`, a fresh jit cache (the pytree structure changed,
+old traces are stale), no optimizer state, and ``_quantized = True`` —
+``fit_batch`` refuses to train it.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.quantize.tensor import quantize_tensor
+
+# layer class name -> {param key: output-channel axis}
+QUANT_RULES: dict[str, dict[str, int]] = {
+    # core dense stacks: W is [n_in, n_out]
+    "DenseLayer": {"W": 1},
+    "OutputLayer": {"W": 1},
+    "RnnOutputLayer": {"W": 1},
+    # attention projections: [D, D] / MLP [D, dff] & [dff, D]
+    "SelfAttentionLayer": {"Wq": 1, "Wk": 1, "Wv": 1, "Wo": 1},
+    "LearnedSelfAttentionLayer": {"Wq": 1, "Wk": 1, "Wv": 1, "Wo": 1},
+    "TransformerEncoderLayer": {"Wq": 1, "Wk": 1, "Wv": 1, "Wo": 1,
+                                "W1": 1, "W2": 1},
+    # conv kernels are [kh, kw, cin//groups, n_out]
+    "ConvolutionLayer": {"W": 3},
+}
+
+
+def quantize_params(params: dict, layer) -> tuple[dict, int]:
+    """Quantize one layer's param table per QUANT_RULES. Returns the (new
+    table, number of tensors quantized); the table is the original object
+    when the layer has no rule (so untouched layers share storage)."""
+    rules = QUANT_RULES.get(type(layer).__name__)
+    if not rules or not params:
+        return params, 0
+    out, n = dict(params), 0
+    for key, axis in rules.items():
+        w = out.get(key)
+        if w is None or getattr(w, "is_quantized", False):
+            continue
+        out[key] = quantize_tensor(w, axis)
+        n += 1
+    return (out, n) if n else (params, 0)
+
+
+def _param_bytes(tree) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def _own(leaf):
+    """Device-copy an array leaf so the view owns its buffer. The training
+    step donates params/state/opt_state buffers to XLA; a view sharing the
+    original's arrays by reference would be left holding deleted buffers
+    after the original's next ``fit_batch``."""
+    return jnp.array(leaf, copy=True) if hasattr(leaf, "dtype") else leaf
+
+
+def quantize_network(net, dtype: str = "int8"):
+    """Return an int8 inference view of a fitted ``MultiLayerNetwork`` or
+    ``ComputationGraph``. The original net is untouched and remains
+    trainable; the view owns copies of every retained f32 leaf, so training
+    the original (whose steps donate buffers) cannot invalidate it."""
+    if dtype != "int8":
+        raise ValueError(f"unsupported quantization dtype {dtype!r}")
+    if getattr(net, "_quantized", False):
+        return net
+
+    t0 = time.perf_counter()
+    bytes_before = _param_bytes(net.params)
+    tensors = 0
+
+    q = copy.copy(net)
+    if isinstance(net.params, list):  # MultiLayerNetwork: params parallel layers
+        new_params = []
+        for layer, p in zip(net.conf.layers, net.params):
+            p2, n = quantize_params(p, layer)
+            new_params.append(p2)
+            tensors += n
+        q.params = new_params
+        q.opt_state = [{} for _ in new_params]
+    else:  # ComputationGraph: params keyed by vertex name
+        new_params = {}
+        for name, p in net.params.items():
+            v = net.conf.vertices[name]
+            layer = getattr(v, "layer", v)
+            p2, n = quantize_params(p, layer)
+            new_params[name] = p2
+            tensors += n
+        q.params = new_params
+        q.opt_state = {}
+    q.params = jax.tree_util.tree_map(_own, q.params)
+    q.state = jax.tree_util.tree_map(_own, net.state)
+    # stale traces close over the old pytree structure
+    q._jit_cache = {}
+    q._quantized = True
+
+    from deeplearning4j_tpu import monitoring
+    mon = monitoring.quantize_monitor()
+    if mon is not None:
+        mon.observe_pass(dtype=dtype, tensors=tensors,
+                         bytes_before=bytes_before,
+                         bytes_after=_param_bytes(q.params),
+                         seconds=time.perf_counter() - t0)
+    return q

@@ -1,0 +1,125 @@
+"""QuantizedTensor: int8 payload + per-output-channel f32 absmax scales.
+
+The representation is a registered pytree node, so a quantized weight lives
+exactly where the f32 weight lived — inside ``net.params`` — and flows
+through ``jit``, ``tree_map`` (``_tree_cast`` touches the floating *scale*
+leaf and leaves the int8 payload alone), the slot pool, and the checkpoint
+writer without special cases. Layers keep their plain ``x @ params["W"]``
+spelling: jax arrays defer ``@`` against an unrecognized right operand, so
+``__rmatmul__`` routes the call into the ``quantized_matmul`` registry op,
+which applies the scale to the accumulator output — the int8 payload is the
+only full-size weight buffer that ever exists.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.registry import op
+import deeplearning4j_tpu.ops.quantized  # noqa: F401  (registers the ops)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """A weight stored as ``q`` (int8) with ``scale`` (f32) per slice of
+    ``axis`` — symmetric absmax: ``w ≈ q * scale`` broadcast over ``axis``.
+
+    ``axis`` is static (pytree aux data): it names the OUTPUT-channel axis
+    of the original weight, which consumers must keep trailing in their
+    result so the scale can be applied to the accumulator output.
+    """
+
+    __slots__ = ("q", "scale", "axis")
+    is_quantized = True
+
+    def __init__(self, q, scale, axis: int = -1):
+        self.q = q
+        self.scale = scale
+        self.axis = int(axis)
+
+    # ------------------------------------------------------------- pytree
+    def tree_flatten(self):
+        return (self.q, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, axis, children):
+        q, scale = children
+        return cls(q, scale, axis)
+
+    # ------------------------------------------------------- array surface
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        # the LOGICAL dtype: what a consumer gets back out
+        return self.scale.dtype
+
+    def __repr__(self):
+        return (f"QuantizedTensor(shape={tuple(self.q.shape)}, "
+                f"axis={self.axis}, scale_shape={tuple(self.scale.shape)})")
+
+    # ---------------------------------------------------------- consumers
+    def __rmatmul__(self, x):
+        """``x @ qw``: the dense-layer spelling. Requires the quantized
+        axis to be the weight's last axis (output channels)."""
+        if self.axis not in (-1, self.q.ndim - 1):
+            raise ValueError(
+                f"matmul needs the quantized axis last (axis={self.axis})")
+        return op("quantized_matmul")(x, self.q, self.scale)
+
+    def __getitem__(self, idx):
+        """Row gather (embedding-table spelling): dequantizes only the
+        gathered rows — activation-sized, never the full table."""
+        rows = self.q[idx]
+        return rows.astype(self.scale.dtype) * self.scale
+
+    def astype(self, dtype):
+        """Dtype casts keep the int8 payload; only the scale moves (this is
+        what ``_tree_cast``'s per-leaf cast does anyway — provided for
+        direct callers)."""
+        return QuantizedTensor(self.q, self.scale.astype(dtype), self.axis)
+
+    def dequantize(self):
+        """Materialize the f32 weight (DEBUG/test only — the inference
+        paths must never call this; the tier-1 jaxpr witness enforces it)."""
+        scale = jnp.expand_dims(self.scale, _reduce_axes(self.q.ndim,
+                                                         self.axis))
+        return self.q.astype(self.scale.dtype) * scale
+
+    def nbytes(self) -> int:
+        return int(np.prod(self.q.shape)) + int(
+            np.prod(self.scale.shape)) * self.scale.dtype.itemsize
+
+
+def _reduce_axes(ndim: int, axis: int):
+    axis = axis % ndim
+    return tuple(a for a in range(ndim) if a != axis)
+
+
+def quantize_tensor(w, axis: int = -1, dtype: str = "int8") -> QuantizedTensor:
+    """Symmetric absmax int8 quantization of ``w`` per slice of ``axis``
+    (the output-channel axis): ``scale = absmax / 127``, ``q = round(w /
+    scale)`` clipped to [-127, 127]. Host-side (numpy) — this is a
+    post-training pass, not a traced computation."""
+    if dtype != "int8":
+        raise ValueError(f"unsupported quantization dtype {dtype!r}")
+    w = np.asarray(w, np.float32)
+    axis = axis % w.ndim
+    red = _reduce_axes(w.ndim, axis)
+    absmax = np.abs(w).max(axis=red) if red else np.abs(w)
+    scale = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
+    q = np.clip(np.rint(w / np.expand_dims(scale, red)), -127,
+                127).astype(np.int8)
+    return QuantizedTensor(jnp.asarray(q), jnp.asarray(scale), axis)
+
+
+def dequantize_tensor(t: QuantizedTensor):
+    return t.dequantize()

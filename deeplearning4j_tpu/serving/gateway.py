@@ -199,6 +199,12 @@ class ServingGateway(_HttpServerMixin):
         from deeplearning4j_tpu.util.serialization import restore_model
 
         model = restore_model(body["path"], load_updater=False)
+        q = body.get("quantize")
+        if q is not None:
+            if q != "int8":
+                raise HttpError(400, f"unsupported quantize dtype {q!r} "
+                                     "(only 'int8')")
+            model = model.quantize(q)
         shape = body.get("warmup_shape")
         mv = self.registry.load(
             body["name"], body["version"], model,

@@ -45,6 +45,7 @@ class ModelVersion:
                 "backlog": self.pi.backlog(),
                 "healthy": self.pi.healthy(),
                 "worker_restarts": self.pi.restarts,
+                "quantized": bool(getattr(self.model, "_quantized", False)),
                 "loaded_at": self.loaded_at}
 
 

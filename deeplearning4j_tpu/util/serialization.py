@@ -28,7 +28,10 @@ FORMAT_VERSION = 1
 
 
 def _flatten(tree, prefix=""):
-    """Flatten a nested list/dict pytree into {path: array}."""
+    """Flatten a nested list/dict pytree into {path: array}. A
+    QuantizedTensor leaf becomes three sub-entries (``__q__`` int8 payload,
+    ``__scale__``, ``__axis__``) so the int8 model round-trips without ever
+    dequantizing."""
     out = {}
     if isinstance(tree, dict):
         for k, v in tree.items():
@@ -38,13 +41,21 @@ def _flatten(tree, prefix=""):
             out.update(_flatten(v, f"{prefix}{i}/"))
     elif tree is None:
         pass
+    elif getattr(tree, "is_quantized", False):
+        key = prefix.rstrip("/")
+        out[key + "/__q__"] = np.asarray(tree.q)
+        out[key + "/__scale__"] = np.asarray(tree.scale)
+        out[key + "/__axis__"] = np.asarray(tree.axis)
     else:
         out[prefix.rstrip("/")] = np.asarray(tree)
     return out
 
 
 def _unflatten_into(template, flat):
-    """Rebuild arrays into the same structure as ``template``."""
+    """Rebuild arrays into the same structure as ``template``. Leaves saved
+    as ``__q__``/``__scale__``/``__axis__`` triples rebuild into
+    QuantizedTensors even though the freshly-initialized template holds a
+    plain f32 array there."""
 
     def rebuild(t, prefix=""):
         if isinstance(t, dict):
@@ -55,6 +66,12 @@ def _unflatten_into(template, flat):
         if t is None:
             return None
         key = prefix.rstrip("/")
+        if key + "/__q__" in flat:
+            from deeplearning4j_tpu.quantize.tensor import QuantizedTensor
+
+            return QuantizedTensor(jnp.asarray(flat[key + "/__q__"]),
+                                   jnp.asarray(flat[key + "/__scale__"]),
+                                   int(flat[key + "/__axis__"]))
         return jnp.asarray(flat[key])
 
     return rebuild(template)
@@ -78,6 +95,7 @@ def write_model(model, path: str, save_updater: bool = True):
         "model_class": "ComputationGraph" if is_graph else "MultiLayerNetwork",
         "step_count": model.step_count,
         "epoch_count": model.epoch_count,
+        "quantized": bool(getattr(model, "_quantized", False)),
     }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("configuration.json", model.conf.to_json())
@@ -104,6 +122,11 @@ def _restore(path: str, model_factory, conf_parser, load_updater: bool):
                 model.opt_state = _unflatten_into(model.opt_state, upd)
         model.step_count = meta.get("step_count", 0)
         model.epoch_count = meta.get("epoch_count", 0)
+        if meta.get("quantized"):
+            model._quantized = True
+            # an inference view carries no optimizer state (fit is guarded)
+            model.opt_state = ([{} for _ in model.params]
+                               if isinstance(model.params, list) else {})
     return model
 
 

@@ -58,6 +58,23 @@ def _mk_model(seed):
 
 model, stable, candidate = _mk_model(0), _mk_model(1), _mk_model(2)
 """,
+    "quantization.md": """
+import numpy as np
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(lr=0.1))
+        .list()
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6)).build())
+_rng = np.random.default_rng(0)
+features = _rng.normal(size=(32, 6)).astype(np.float32)
+labels = np.eye(3, dtype=np.float32)[_rng.integers(0, 3, 32)]
+x = features[:4]
+""",
     "datavec.md": """
 import numpy as np
 from deeplearning4j_tpu.datavec import CSVRecordReader, Schema

@@ -523,3 +523,93 @@ class TestImportGraph:
             "assert not bad, bad"
         )
         subprocess.run([sys.executable, "-c", code], check=True)
+
+
+# ----------------------------------------------------- ring wrap-around
+class TestRingWraparound:
+    """Decode past ``pos >= max_len``: the KV ring wraps (slot = pos % L)
+    and attention becomes a sliding window over the last L tokens. The
+    reference recomputes each step's logits from scratch over exactly that
+    window, with ABSOLUTE positional embeddings (``P[abs_pos]``, matching
+    what the ring rows were written with) — for a single transformer layer
+    the two are algebraically identical. Checked for the f32 cache at 1e-5
+    and the int8 cache on the post-softmax distribution, with the
+    compile-counter witness holding decode to ONE program through the
+    wrap."""
+
+    L = 8  # ring length; decode runs to pos ~20, wrapping 2.5 times
+
+    @pytest.fixture(scope="class")
+    def wrap_net(self):
+        D = 16
+        conf = (
+            NeuralNetConfiguration.builder().seed(11).list()
+            .layer(EmbeddingSequenceLayer(n_out=D, n_in=V))
+            .layer(PositionalEmbeddingLayer(max_len=64))
+            .layer(TransformerEncoderLayer(d_model=D, n_heads=2,
+                                           causal=True))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(V, 12))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def _window_logits(self, net, tokens, t):
+        """Reference: full recompute over the last-L window ending at
+        absolute position ``t``, positions kept absolute."""
+        start = max(0, t - self.L + 1)
+        win = tokens[:, start:t + 1]
+        emb, pos_l, tf_l, out_l = net.layers
+        x = net.params[0]["W"][win]
+        if emb.has_bias:
+            x = x + net.params[0]["b"]
+        x = x + net.params[1]["P"][jnp.arange(start, t + 1)]
+        y, _ = tf_l.apply(net.params[2], net.state[2], x, train=False)
+        return out_l.preout(net.params[3], y[:, -1:, :])[:, 0]
+
+    def _run(self, net, kv_dtype, tokens, steps):
+        from deeplearning4j_tpu.generation.engine import (
+            AttentionDecodeAdapter)
+        ad = AttentionDecodeAdapter(net, self.L, kv_dtype=kv_dtype)
+        B, T0 = tokens.shape[0], 4
+        caches = ad.prefill(net.params, net.state, tokens[:, :T0], None)
+        dec = jax.jit(ad.decode)
+        out = []
+        for t in range(T0 - 1, T0 - 1 + steps):
+            pos = jnp.full((B,), t, jnp.int32)
+            logits, caches = dec(net.params, net.state, caches,
+                                 tokens[:, t], pos)
+            out.append(logits)
+        assert dec._cache_size() == 1   # one program through the wrap
+        return out
+
+    def test_f32_ring_matches_sliding_window(self, wrap_net):
+        rng = np.random.default_rng(20)
+        B, steps = 2, 18                       # pos runs 3..20 (wraps at 8)
+        tokens = jnp.asarray(rng.integers(0, V, (B, 4 + steps)))
+        got = self._run(wrap_net, None, tokens, steps)
+        for k, logits in enumerate(got):
+            t = 3 + k
+            ref = self._window_logits(wrap_net, tokens, t)
+            np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                       atol=2e-5,
+                                       err_msg=f"abs pos {t} (wrapped: "
+                                               f"{t >= self.L})")
+
+    def test_int8_ring_tracks_f32_through_wrap(self, wrap_net):
+        rng = np.random.default_rng(21)
+        B, steps = 2, 18
+        tokens = jnp.asarray(rng.integers(0, V, (B, 4 + steps)))
+        f32 = self._run(wrap_net, None, tokens, steps)
+        int8 = self._run(wrap_net, "int8", tokens, steps)
+        worst = 0.0
+        for lf, lq in zip(f32, int8):
+            pf, pq = jax.nn.softmax(lf, -1), jax.nn.softmax(lq, -1)
+            worst = max(worst, float(jnp.abs(pf - pq).max()))
+        assert worst <= 1e-2
+        # the wrapped steps specifically (pos >= L) stay in agreement
+        tail_agree = np.mean([
+            np.asarray(jnp.argmax(lf, -1) == jnp.argmax(lq, -1)).mean()
+            for lf, lq in zip(f32[self.L:], int8[self.L:])])
+        assert tail_agree >= 0.9

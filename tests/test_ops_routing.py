@@ -1,0 +1,93 @@
+"""Routing regression tests: pin the registry's pallas/xla decision for the
+measured kernel shapes so a predicate edit that silently demotes a measured
+winner (or promotes an unmeasured shape) fails loudly.
+
+select() only reads .shape/.dtype off its operands, so jax.ShapeDtypeStruct
+stands in for real arrays where the dtype (f64) can't be materialized
+without flipping the global x64 switch. The recurrent predicates size their
+VMEM plan from R's dtype panel width; bf16 R pins the TPU-regime plan on
+every backend.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.registry import get_op
+
+S = jax.ShapeDtypeStruct
+
+
+def _lstm_args(B, H, xdt=jnp.bfloat16, rdt=jnp.bfloat16, I=16, T=4):
+    return (S((B, T, I), xdt), S((B, H), xdt), S((B, H), xdt),
+            S((I, 4 * H), xdt), S((H, 4 * H), rdt), S((4 * H,), xdt))
+
+
+def _gru_args(B, H, xdt=jnp.bfloat16, rdt=jnp.bfloat16, I=16, T=4):
+    return (S((B, T, I), xdt), S((B, H), xdt),
+            S((I, 3 * H), xdt), S((H, 3 * H), rdt), S((3 * H,), xdt))
+
+
+class TestLrnRouting:
+    """AlexNet conv2 LRN shape [64, 27, 27, 256]: measured pallas win
+    (r4: fwd 1.26x, train 1.47x). The dtype gate keeps everything outside
+    the measured f32/bf16 regime on the XLA lowering."""
+
+    def test_alexnet_shape_routes_to_pallas(self):
+        op = get_op("lrn")
+        assert op.select(S((64, 27, 27, 256), jnp.float32)).platform == "pallas"
+        assert op.select(S((64, 27, 27, 256), jnp.bfloat16)).platform == "pallas"
+
+    def test_f64_stays_on_xla(self):
+        assert get_op("lrn").select(
+            S((64, 27, 27, 256), jnp.float64)).platform == "xla"
+
+    def test_oversize_channels_stay_on_xla(self):
+        # C > 1024: the [C, C] band no longer fits the VMEM budget
+        assert get_op("lrn").select(
+            S((64, 27, 27, 2048), jnp.float32)).platform == "xla"
+
+    def test_tiny_row_count_stays_on_xla(self):
+        assert get_op("lrn").select(
+            S((4, 4, 4, 256), jnp.float32)).platform == "xla"
+
+
+class TestLstmRouting:
+    """B=256/H=1024 is the r3-demoted shape the r4 batch-blocked grid won
+    back (fwd 1.10x / train 1.33x, BASELINE.md). Pin it on pallas, and pin
+    the exclusions: misaligned batch, no-resident-plan H, non-MXU dtypes."""
+
+    def test_b256_h1024_routes_to_pallas(self):
+        op = get_op("lstm_layer")
+        assert op.select(*_lstm_args(256, 1024)).platform == "pallas"
+        assert op.select(*_lstm_args(256, 1024,
+                                     xdt=jnp.float32)).platform == "pallas"
+
+    def test_f64_stays_on_xla(self):
+        assert get_op("lstm_layer").select(
+            *_lstm_args(256, 1024, xdt=jnp.float64,
+                        rdt=jnp.float64)).platform == "xla"
+
+    def test_misaligned_batch_stays_on_xla(self):
+        assert get_op("lstm_layer").select(
+            *_lstm_args(250, 1024)).platform == "xla"
+
+    def test_no_resident_plan_stays_on_xla(self):
+        assert get_op("lstm_layer").select(
+            *_lstm_args(256, 2048)).platform == "xla"
+
+
+class TestGruRouting:
+    """Same selection policy as the LSTM (shared plan machinery)."""
+
+    def test_b256_h1024_routes_to_pallas(self):
+        assert get_op("gru_layer").select(
+            *_gru_args(256, 1024)).platform == "pallas"
+
+    def test_f64_stays_on_xla(self):
+        assert get_op("gru_layer").select(
+            *_gru_args(256, 1024, xdt=jnp.float64,
+                       rdt=jnp.float64)).platform == "xla"
+
+    def test_no_resident_plan_stays_on_xla(self):
+        assert get_op("gru_layer").select(
+            *_gru_args(256, 2048)).platform == "xla"
