@@ -1696,6 +1696,184 @@ def bench_serving_gateway(n_requests=384, clients=16, batch_limit=32,
     }
 
 
+def bench_chaos(interactive_clients=6, batch_clients=10,
+                interactive_per=20, batch_per=12, objective_ms=2000.0,
+                spike_factor=3):
+    """Chaos lane (PR 11): the multi-tenant gateway under injected faults.
+
+    A small dense MLP behind a ServingGateway configured with two tenants
+    (``interactive`` > ``batch``), a per-class latency SLO, replica
+    autoscaling, and deliberately tight per-lane queues. Two phases over
+    the SAME gateway:
+
+      - steady: both classes run closed-loop, nothing armed;
+      - chaos: the faults grammar arms ``worker_crash`` (self-healed
+        restarts), ``slow_worker`` (random dispatch stalls), and
+        ``traffic_spike`` — batch clients poll the spike trigger and
+        multiply their offered load while it fires, so the grammar drives
+        the OFFERED load, not just the serving side.
+
+    Acceptance (reported in the artifact): interactive p99 stays within
+    its objective through the chaos phase while the batch class sheds
+    (429s) > 0, and the per-class ``dl4j_serving_shed_total`` deltas
+    witness shed-lowest-class-first."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from deeplearning4j_tpu import faults, monitoring
+    from deeplearning4j_tpu.nn import (
+        InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import ServingGateway
+
+    monitoring.enable()
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=8, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(32)).build())
+    model = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 32)).astype(np.float32)
+
+    def pctl(lat, q):
+        if not lat:
+            return None
+        return float(np.percentile(np.asarray(lat) * 1000.0, q))
+
+    def shed_by_class():
+        fam = monitoring.registry().get("dl4j_serving_shed_total")
+        out = {}
+        if fam is not None:
+            for key, child in fam.children():   # key = (model, reason, class)
+                out[key[2]] = out.get(key[2], 0.0) + child.value
+        return out
+
+    gw = ServingGateway(
+        port=0, batch_limit=4, max_queue=6, seed=0,
+        tenants=[{"key": "key-int", "name": "interactive-tenant",
+                  "klass": "interactive"},
+                 {"key": "key-bat", "name": "batch-tenant",
+                  "klass": "batch"}],
+        slo={"interactive": {"objective_ms": objective_ms, "target": 0.99}},
+        autoscale={"max_replicas": 2, "high_backlog": 4.0,
+                   "scale_up_after": 2, "interval_s": 0.1}).start()
+    base = f"http://127.0.0.1:{gw.port}"
+    mv = gw.register_model("mlp", "v1", model, warmup_shape=(32,),
+                           batch_limit=4)
+
+    def fire(key, i):
+        req = urllib.request.Request(
+            base + "/v1/mlp/predict",
+            data=_json.dumps({"inputs": [xs[i % len(xs)].tolist()],
+                              "timeout_ms": 60000,
+                              "api_key": key}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        try:
+            urllib.request.urlopen(req, timeout=90).read()
+            return 200, time.perf_counter() - t0
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, time.perf_counter() - t0
+        except (ConnectionResetError, urllib.error.URLError):
+            return 599, time.perf_counter() - t0
+
+    def run_phase(tag, plan):
+        stats = {"interactive": {"lat": [], "codes": {}},
+                 "batch": {"lat": [], "codes": {}}}
+        lock = threading.Lock()
+        shed_before = shed_by_class()
+
+        def client(klass, key, per, ci):
+            mine_lat, mine_codes = [], {}
+            for i in range(per):
+                # the spike trigger multiplies the BATCH offered load
+                burst = (spike_factor
+                         if (plan is not None and klass == "batch"
+                             and plan.fires("traffic_spike")) else 1)
+                for b in range(burst):
+                    code, dt = fire(key, ci * per + i + b)
+                    mine_codes[code] = mine_codes.get(code, 0) + 1
+                    if code == 200:
+                        mine_lat.append(dt)
+            with lock:
+                stats[klass]["lat"].extend(mine_lat)
+                for c, n in mine_codes.items():
+                    stats[klass]["codes"][c] = (
+                        stats[klass]["codes"].get(c, 0) + n)
+
+        threads = (
+            [threading.Thread(target=client,
+                              args=("interactive", "key-int",
+                                    interactive_per, ci))
+             for ci in range(interactive_clients)] +
+            [threading.Thread(target=client,
+                              args=("batch", "key-bat", batch_per, ci))
+             for ci in range(batch_clients)])
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        shed_after = shed_by_class()
+        out = {"wall_s": round(dt, 2),
+               "shed_delta_by_class": {
+                   k: shed_after.get(k, 0.0) - shed_before.get(k, 0.0)
+                   for k in set(shed_before) | set(shed_after)}}
+        for klass, s in stats.items():
+            total = sum(s["codes"].values())
+            out[klass] = {
+                "p50_ms": pctl(s["lat"], 50), "p99_ms": pctl(s["lat"], 99),
+                "requests": total, "served": s["codes"].get(200, 0),
+                "shed_429": s["codes"].get(429, 0),
+                "shed_rate": round(s["codes"].get(429, 0) / max(total, 1),
+                                   3),
+                "codes": {str(k): v for k, v in s["codes"].items()}}
+        code = urllib.request.urlopen(base + "/slo", timeout=10)
+        out["slo"] = _json.loads(code.read())
+        return out
+
+    try:
+        steady = run_phase("steady", plan=None)
+        with faults.injected(
+                "worker_crash:2;slow_worker:0.4;traffic_spike:0.5",
+                seed=0, delay_s=0.08) as plan:
+            chaos = run_phase("chaos", plan=plan)
+            injected = dict(plan.injected)
+        replicas_final = mv.pi.replicas()
+    finally:
+        gw.stop()
+    chaos_shed = chaos["shed_delta_by_class"]
+    return {
+        "model": "dense MLP 32->64->8 (multi-tenant gateway)",
+        "objective_ms": objective_ms,
+        "steady": steady,
+        "chaos": chaos,
+        "faults_injected": injected,
+        "replicas_final": replicas_final,
+        "acceptance": {
+            "interactive_p99_within_objective":
+                chaos["interactive"]["p99_ms"] is not None
+                and chaos["interactive"]["p99_ms"] <= objective_ms,
+            "batch_shed_gt_zero": chaos["batch"]["shed_429"] > 0,
+            "shed_order_lowest_first":
+                chaos_shed.get("batch", 0.0)
+                >= chaos_shed.get("interactive", 0.0),
+        },
+        "note": "chaos arms worker_crash (self-healed), slow_worker "
+                "(dispatch stalls), traffic_spike (batch clients poll the "
+                "trigger and burst). Interactive rides the priority lane, "
+                "so its p99 holds while the batch lane absorbs the shed.",
+    }
+
+
 def bench_generate(n_requests=48, slots=8, units=256, vocab=77,
                    budget_deadline=None):
     """Generation-engine lane (continuous-batching PR): autoregressive
@@ -2463,6 +2641,18 @@ def main():
             "vs_baseline": None,
             "overload_shed_rate": t["overload"]["shed_rate"],
             "serving_gateway": t,
+        }))
+        return
+    if mode == "chaos":
+        t = bench_chaos()
+        print(json.dumps({
+            "metric": "multi-tenant chaos lane (worker crash + slow "
+                      "worker + traffic spike vs per-class SLOs)",
+            "value": t["chaos"]["interactive"]["p99_ms"],
+            "unit": "ms interactive p99 under chaos",
+            "vs_baseline": t["steady"]["interactive"]["p99_ms"],
+            "acceptance": t["acceptance"],
+            "chaos": t,
         }))
         return
     if mode == "bert_import":

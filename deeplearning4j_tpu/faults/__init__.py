@@ -22,6 +22,14 @@ Fault classes (the injection points that consume them in parentheses):
                          (parallel.spark local-SGD round supervisor)
     ``data_io``          dataset read error (datasets.iterators, mnist)
     ``infer_crash``      inference-worker crash (parallel.inference)
+    ``slow_worker``      inference worker stalls for ``delay_s`` before
+                         dispatching a batch — the latency half of chaos
+                         testing (parallel.inference)
+    ``traffic_spike``    load-generator burst trigger: clients/bench loops
+                         that poll it multiply their request rate while it
+                         fires (bench.py chaos, tests) — the faults
+                         grammar drives the OFFERED load, not just the
+                         serving side
 
 Spec grammar (``DL4J_TPU_FAULTS`` env var or :func:`configure`)::
 
@@ -59,7 +67,8 @@ from typing import Dict, List, Optional
 from deeplearning4j_tpu.faults.retry import RetryPolicy  # noqa: F401 (re-export)
 
 CLASSES = ("ckpt_io", "ckpt_corrupt", "coord_connect", "collective_delay",
-           "worker_crash", "data_io", "infer_crash")
+           "worker_crash", "data_io", "infer_crash", "slow_worker",
+           "traffic_spike")
 
 ENV_SPEC = "DL4J_TPU_FAULTS"
 ENV_SEED = "DL4J_TPU_FAULTS_SEED"

@@ -347,6 +347,10 @@ class GenerationEngine:
         self._prefill = jax.jit(self.adapter.prefill)
         self._pending: "collections.deque[GenerationStream]" = (
             collections.deque())
+        # low-priority lane (klass="batch"): admitted into freed slots only
+        # when no interactive/default request is waiting
+        self._pending_lo: "collections.deque[GenerationStream]" = (
+            collections.deque())
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._running = False
@@ -378,8 +382,11 @@ class GenerationEngine:
     def submit(self, prompt: Union[str, Sequence[int]], *,
                max_new_tokens: int = 32, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> GenerationStream:
-        """Queue a request; returns its token stream immediately."""
+               eos_id: Optional[int] = None,
+               klass: Optional[str] = None) -> GenerationStream:
+        """Queue a request; returns its token stream immediately.
+        ``klass="batch"`` rides the low-priority pending lane — freed
+        slots go to interactive/default requests first."""
         if isinstance(prompt, str):
             if self.codec is None:
                 raise ValueError("string prompt needs a codec")
@@ -407,17 +414,21 @@ class GenerationEngine:
         with self._cond:
             if not self._accepting:
                 raise RuntimeError("engine is shut down")
-            self._pending.append(stream)
+            if klass == "batch":
+                self._pending_lo.append(stream)
+            else:
+                self._pending.append(stream)
             self._cond.notify_all()
         return stream
 
     def has_work(self) -> bool:
-        return bool(self._pending) or self.pool.occupancy() > 0
+        return (bool(self._pending) or bool(self._pending_lo)
+                or self.pool.occupancy() > 0)
 
     def pending_count(self) -> int:
-        """Queued-but-not-yet-admitted requests (the admission-control
-        backlog signal)."""
-        return len(self._pending)
+        """Queued-but-not-yet-admitted requests across both priority lanes
+        (the admission-control backlog signal)."""
+        return len(self._pending) + len(self._pending_lo)
 
     # ---------------------------------------------------------- scheduler
     def _prefill_state(self, ids: Tuple[int, ...]):
@@ -436,9 +447,15 @@ class GenerationEngine:
         free = self.pool.free_slots()
         while free:
             with self._cond:
-                if not self._pending:
+                # interactive/default lane first: a freed slot is never
+                # given to queued batch work while higher-priority requests
+                # are waiting
+                if self._pending:
+                    stream = self._pending.popleft()
+                elif self._pending_lo:
+                    stream = self._pending_lo.popleft()
+                else:
                     return
-                stream = self._pending.popleft()
             if stream.cancelled:
                 self._finish_stream(stream, "cancelled")
                 continue
@@ -561,9 +578,11 @@ class GenerationEngine:
         else:
             while time.monotonic() < deadline and self.has_work():
                 self.step()
-        # past the deadline: cancel stragglers
+        # past the deadline: cancel stragglers (both priority lanes)
         with self._cond:
-            pending, self._pending = list(self._pending), collections.deque()
+            pending = list(self._pending) + list(self._pending_lo)
+            self._pending = collections.deque()
+            self._pending_lo = collections.deque()
         for stream in pending:
             self._finish_stream(stream, "cancelled")
         for s in self.pool.active_slots():

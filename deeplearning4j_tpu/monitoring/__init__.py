@@ -50,6 +50,8 @@ _recovery_mon = None
 _compile_mon = None
 _generate_mon = None
 _quantize_mon = None
+_tenant_mon = None
+_slo_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -78,12 +80,13 @@ def reset() -> None:
     global _REGISTRY, _tracer, _enabled
     global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
     global _recovery_mon, _compile_mon, _generate_mon, _quantize_mon
+    global _tenant_mon, _slo_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
     _import_mon = _recovery_mon = _compile_mon = _generate_mon = None
-    _quantize_mon = None
+    _quantize_mon = _tenant_mon = _slo_mon = None
 
 
 def metrics_text() -> str:
@@ -218,8 +221,9 @@ class _ServingMonitor:
             labels=("model", "version", "code"))
         self.shed_total = reg.counter(
             "dl4j_serving_shed_total",
-            "Requests shed by admission control, by reason",
-            labels=("model", "reason"))
+            "Requests shed by admission control, by reason and priority "
+            "class (class='default' for untenanted traffic)",
+            labels=("model", "reason", "class"))
         self.model_queue_depth = reg.gauge(
             "dl4j_serving_model_queue_depth",
             "Admitted-but-undispatched requests per model worker",
@@ -233,6 +237,15 @@ class _ServingMonitor:
             "dl4j_serving_model_loaded",
             "1 while the (model, version) is registered and servable",
             labels=("model", "version"))
+        # ---- autoscaling tier ----
+        self.replicas = reg.gauge(
+            "dl4j_serving_replicas",
+            "Inference worker replicas currently running per model version",
+            labels=("model", "version"))
+        self.autoscale_total = reg.counter(
+            "dl4j_serving_autoscale_total",
+            "Autoscaler replica changes, by direction (up/down)",
+            labels=("model", "version", "direction"))
 
 
 class _LocalSgdMonitor:
@@ -359,6 +372,54 @@ class _GenerateMonitor:
             "Active sequence slots after the latest decode step")
 
 
+class _TenantMonitor:
+    """Multi-tenant gateway instruments: per-tenant request outcomes
+    (admitted / quota_requests / quota_tokens / unauthorized), token spend,
+    and remaining sliding-window quota headroom — the runbook view of which
+    tenant an overload is coming from and which quota is biting."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.requests_total = reg.counter(
+            "dl4j_tenant_requests_total",
+            "Tenant-authenticated requests, by tenant and outcome",
+            labels=("tenant", "outcome"))
+        self.tokens_total = reg.counter(
+            "dl4j_tenant_tokens_total",
+            "Quota tokens charged across all requests, by tenant",
+            labels=("tenant",))
+        self.quota_remaining = reg.gauge(
+            "dl4j_tenant_quota_remaining",
+            "Sliding-window quota headroom after the latest charge, by "
+            "tenant and resource (requests/tokens)",
+            labels=("tenant", "resource"))
+
+
+class _SloMonitor:
+    """SLO-layer instruments: per-priority-class latency distribution,
+    objective violations, and the burn rate (observed violation fraction /
+    error budget) the shed-lowest-class-first policy acts on. Burn rate
+    > 1.0 on a class means its error budget is being consumed faster than
+    the objective allows — lower classes start shedding."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.latency_seconds = reg.histogram(
+            "dl4j_slo_latency_seconds",
+            "Served-request latency per priority class", labels=("class",))
+        self.violations_total = reg.counter(
+            "dl4j_slo_violations_total",
+            "Requests that missed their class latency objective",
+            labels=("class",))
+        self.burn_rate = reg.gauge(
+            "dl4j_slo_burn_rate",
+            "Error-budget burn rate per class over the sliding window",
+            labels=("class",))
+        self.objective_seconds = reg.gauge(
+            "dl4j_slo_objective_seconds",
+            "Configured latency objective per class", labels=("class",))
+
+
 class _QuantizeMonitor:
     """Quantization-tier instruments: each ``quantize_network`` pass records
     how many weight tensors moved to int8, the param-tree footprint before
@@ -443,6 +504,14 @@ def quantize_monitor() -> Optional[_QuantizeMonitor]:
     return _bundle("_quantize_mon", _QuantizeMonitor)
 
 
+def tenant_monitor() -> Optional[_TenantMonitor]:
+    return _bundle("_tenant_mon", _TenantMonitor)
+
+
+def slo_monitor() -> Optional[_SloMonitor]:
+    return _bundle("_slo_mon", _SloMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 
 __all__ = [
@@ -453,4 +522,5 @@ __all__ = [
     "fit_monitor", "serving_monitor", "localsgd_monitor",
     "checkpoint_monitor", "import_monitor", "recovery_monitor",
     "compile_monitor", "generate_monitor", "quantize_monitor",
+    "tenant_monitor", "slo_monitor",
 ]

@@ -17,12 +17,28 @@ flushes already-admitted requests before joining — the graceful-drain half
 of the gateway lifecycle.
 
 Self-healing (fault-injection PR): the worker is SUPERVISED. A crash that
-escapes the forward-pass handler (ragged stack, injected ``infer_crash``,
-a bug anywhere in dispatch) fans the error back to the in-flight batch and
-revives the loop in place; a thread found dead at submit time is restarted
-before the request is admitted. Every revival increments ``restarts`` and
-``dl4j_recovery_total{component="serving"}``, and ``healthy()`` feeds the
-gateway's degraded-state /healthz report.
+escapes the forward-pass handler (ragged stack, injected ``infer_crash`` /
+``worker_crash``, a bug anywhere in dispatch) fans the error back to the
+in-flight batch and revives the loop in place; a thread found dead at submit
+time is restarted before the request is admitted. Every revival increments
+``restarts`` and ``dl4j_recovery_total{component="serving"}``, and
+``healthy()`` feeds the gateway's degraded-state /healthz report.
+
+Multi-tenant extensions (PR 11):
+
+- **Priority lanes.** ``submit(..., klass="batch")`` routes a request to the
+  low-priority lane; everything else (``klass=None`` or ``"interactive"``)
+  rides the primary lane. Workers always drain the primary lane first, so
+  interactive traffic preempts queued batch work without starving it (batch
+  is served whenever the primary lane is empty). A counting semaphore gates
+  both lanes, so batch-only load never waits on an empty primary lane.
+- **Replicas.** ``replicas`` worker threads share the lanes;
+  ``set_replicas(n)`` grows/shrinks the pool live (surplus workers retire
+  at their next loop check) — the autoscaler's actuation point.
+- **Queue-depth truth.** ``on_depth(backlog)`` fires every time requests
+  leave the lanes — normal dispatch AND deadline sheds — so the per-model
+  ``dl4j_serving_model_queue_depth`` gauge decays on the shed path too
+  instead of freezing at its last submit-time value.
 """
 
 from __future__ import annotations
@@ -30,7 +46,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -57,16 +73,23 @@ class ParallelInference:
 
     batch_limit: max requests coalesced into one device batch;
     queue_timeout_s: max wait to fill a batch before running partial;
-    max_queue: bound on admitted-but-undispatched requests (0 = unbounded;
-    when full, ``submit`` raises ``queue.Full`` — backpressure, not pile-up);
-    on_shed: optional callback(n) invoked when n deadline-expired requests
-    are shed at dispatch.
+    max_queue: bound on admitted-but-undispatched requests PER LANE (0 =
+    unbounded; when full, ``submit`` raises ``queue.Full`` — backpressure,
+    not pile-up);
+    replicas: worker threads sharing the lanes (autoscaler-adjustable via
+    :meth:`set_replicas`);
+    on_shed: optional callback(n, klass) invoked when n deadline-expired
+    requests of priority class ``klass`` are shed at dispatch;
+    on_depth: optional callback(backlog) invoked whenever requests leave
+    the lanes (dispatch or shed) — the queue-depth gauge feed.
     """
 
     def __init__(self, model, mesh: Optional[DeviceMesh] = None,
                  batch_limit: int = 32, queue_timeout_s: float = 0.005,
                  pad_batches: bool = True, max_queue: int = 0,
-                 on_shed: Optional[Callable[[int], None]] = None):
+                 replicas: int = 1,
+                 on_shed: Optional[Callable] = None,
+                 on_depth: Optional[Callable[[int], None]] = None):
         self.model = model
         self.mesh = mesh
         self.batch_limit = batch_limit
@@ -79,11 +102,15 @@ class ParallelInference:
         self.pad_batches = pad_batches
         self.max_queue = max_queue
         self.on_shed = on_shed
-        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
-        self._worker: Optional[threading.Thread] = None
+        self.on_depth = on_depth
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)       # primary
+        self._q_lo: queue.Queue = queue.Queue(maxsize=max_queue)    # batch
+        self._sem = threading.Semaphore(0)   # counts items across both lanes
+        self._workers: Dict[int, threading.Thread] = {}
+        self._target = max(1, int(replicas))
         self._stop = threading.Event()
         self._accepting = False
-        # self-healing bookkeeping: how many times the worker loop was
+        # self-healing bookkeeping: how many times a worker loop was
         # revived after an unexpected death (crash escaping the per-batch
         # handler, or a thread found dead at submit time)
         self.restarts = 0
@@ -96,62 +123,114 @@ class ParallelInference:
                 return self.model.output(x)
         return self.model.output(x)
 
+    # --- single-worker compatibility shims (tests poke worker 0) ---
+    @property
+    def _worker(self) -> Optional[threading.Thread]:
+        return self._workers.get(0)
+
+    @_worker.setter
+    def _worker(self, thread: Optional[threading.Thread]) -> None:
+        if thread is None:
+            self._workers.pop(0, None)
+        else:
+            self._workers[0] = thread
+
     # --- async batched API ---
     def start(self):
         self._stop.clear()
         self._accepting = True
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        for i in range(self._target):
+            self._spawn(i)
         return self
 
+    def _spawn(self, idx: int) -> None:
+        t = threading.Thread(target=self._run, args=(idx,), daemon=True)
+        self._workers[idx] = t
+        t.start()
+
+    def replicas(self) -> int:
+        """Live worker-thread count (the autoscaler's observed state)."""
+        return sum(1 for w in self._workers.values() if w.is_alive())
+
+    def set_replicas(self, n: int) -> int:
+        """Grow/shrink the worker pool to ``n`` threads. Growth spawns
+        immediately; shrink is cooperative — surplus workers retire at
+        their next loop check, finishing their in-flight batch first.
+        Returns the new target."""
+        n = max(1, int(n))
+        with self._restart_lock:
+            self._target = n
+            if not self._stop.is_set():
+                for i in range(n):
+                    w = self._workers.get(i)
+                    if w is None or not w.is_alive():
+                        self._spawn(i)
+        return self._target
+
     def stop(self, drain: bool = False, timeout: float = 30.0):
-        """Stop the worker. ``drain=True`` first stops admitting, flushes
+        """Stop the workers. ``drain=True`` first stops admitting, flushes
         every already-queued request (bounded by ``timeout``), and only
         then joins — in-flight work completes instead of being orphaned."""
         self._accepting = False
-        if drain and self._worker is not None and self._worker.is_alive():
+        alive = [w for w in self._workers.values() if w.is_alive()]
+        if drain and alive:
             end = time.monotonic() + timeout
-            while not self._q.empty() and time.monotonic() < end:
+            while self.backlog() and time.monotonic() < end:
                 time.sleep(0.005)
         self._stop.set()
-        if self._worker:
-            self._worker.join(timeout=max(5.0, timeout))
+        for w in self._workers.values():
+            if w.is_alive():
+                w.join(timeout=max(5.0, timeout))
 
     def drain(self, timeout: float = 30.0):
         """Graceful shutdown: stop admitting, flush, join."""
         self.stop(drain=True, timeout=timeout)
 
     def backlog(self) -> int:
-        """Admitted-but-undispatched request count (approximate)."""
-        return self._q.qsize()
+        """Admitted-but-undispatched request count across both lanes
+        (approximate)."""
+        return self._q.qsize() + self._q_lo.qsize()
 
-    def submit(self, x, deadline: Optional[float] = None) -> "queue.Queue":
+    def lane_backlog(self, klass: Optional[str] = None) -> int:
+        """Backlog of the lane ``klass`` routes to. Admission capacity
+        checks use this rather than :meth:`backlog` so a saturated batch
+        lane cannot starve interactive admission — lanes are bounded
+        independently, exactly like ``submit`` routes them."""
+        return (self._q_lo if klass == "batch" else self._q).qsize()
+
+    def submit(self, x, deadline: Optional[float] = None,
+               klass: Optional[str] = None) -> "queue.Queue":
         """Submit one example [features...] -> a result queue of size 1.
 
         ``deadline``: optional ``time.monotonic()`` instant; a request still
         undispatched past it is resolved with :class:`DeadlineExceeded`
-        rather than executed. Raises ``queue.Full`` when a bounded queue is
-        at capacity and ``RuntimeError`` when the server is not accepting
-        (stopped or draining). A worker thread found dead (it should be
-        running while accepting) is restarted before the request is
-        admitted — no request enters a queue nothing is consuming.
+        rather than executed. ``klass``: priority class — ``"batch"`` rides
+        the low-priority lane, anything else the primary lane. Raises
+        ``queue.Full`` when a bounded lane is at capacity and
+        ``RuntimeError`` when the server is not accepting (stopped or
+        draining). Worker threads found dead (they should be running while
+        accepting) are restarted before the request is admitted — no
+        request enters a lane nothing is consuming.
         """
         if not self._accepting:
             raise RuntimeError("ParallelInference is not accepting requests "
                                "(stopped or draining)")
-        if (self._worker is not None and not self._worker.is_alive()
+        if (self._workers
+                and not any(w.is_alive() for w in self._workers.values())
                 and not self._stop.is_set()):
             self._revive("dead_thread")
         out: queue.Queue = queue.Queue(maxsize=1)
-        self._q.put_nowait((np.asarray(x), out, deadline))
+        lane = self._q_lo if klass == "batch" else self._q
+        lane.put_nowait((np.asarray(x), out, deadline, klass))
+        self._sem.release()
         return out
 
     def healthy(self) -> bool:
-        """True while the worker is running (or intentionally stopped);
-        False only in the degraded window between a worker death and its
-        revival."""
-        return (self._worker is None or self._worker.is_alive()
-                or self._stop.is_set())
+        """True while at least one worker is running (or the pool is
+        intentionally stopped); False only in the degraded window between
+        the last worker death and its revival."""
+        return (not self._workers or self._stop.is_set()
+                or any(w.is_alive() for w in self._workers.values()))
 
     def _record_restart(self, outcome: str):
         with self._restart_lock:
@@ -162,24 +241,36 @@ class ParallelInference:
                                       outcome=outcome).inc()
 
     def _revive(self, outcome: str):
-        """Restart a dead worker thread (detected at submit time). Queued
-        requests are preserved — the new thread drains them."""
+        """Restart dead worker threads (detected at submit time). Queued
+        requests are preserved — the new threads drain them."""
+        spawned = False
         with self._restart_lock:
-            if (self._worker is not None and not self._worker.is_alive()
-                    and not self._stop.is_set()):
-                self._worker = threading.Thread(target=self._run, daemon=True)
-                self._worker.start()
-            else:
+            if self._stop.is_set():
                 return
-        mon = monitoring.recovery_monitor()
-        if mon is not None:
-            mon.recovery_total.labels(component="serving",
-                                      outcome=outcome).inc()
-        with self._restart_lock:
-            self.restarts += 1
+            for i in range(self._target):
+                w = self._workers.get(i)
+                if w is not None and not w.is_alive():
+                    self._spawn(i)
+                    spawned = True
+        if spawned:
+            self._record_restart(outcome)
 
-    def _run(self):
+    def _pop(self, timeout: float):
+        """One request off the lanes, primary first; None on timeout. A
+        semaphore permit guarantees an item exists across the two lanes,
+        so batch-only load never stalls behind a blocking get on the empty
+        primary lane."""
+        if not self._sem.acquire(timeout=timeout):
+            return None
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return self._q_lo.get_nowait()
+
+    def _run(self, idx: int = 0):
         while not self._stop.is_set():
+            if idx >= self._target:
+                return          # autoscaler shrank the pool; retire quietly
             try:
                 self._serve_once()
             except Exception:  # noqa: BLE001 — a crash that escaped the
@@ -195,46 +286,53 @@ class ParallelInference:
         """Pull + dispatch one batch. Any exception after requests are
         dequeued is fanned back to every unresolved waiter before it
         propagates — no future is ever silently dropped."""
-        batch = []
-        try:
-            batch.append(self._q.get(timeout=0.05))
-        except queue.Empty:
+        first = self._pop(timeout=0.05)
+        if first is None:
             return
+        batch = [first]
         while len(batch) < self.batch_limit:
-            try:
-                batch.append(self._q.get(timeout=self.queue_timeout_s))
-            except queue.Empty:
+            item = self._pop(timeout=self.queue_timeout_s)
+            if item is None:
                 break
+            batch.append(item)
+        if self.on_depth is not None:
+            # requests just left the lanes; every exit path below (shed,
+            # dispatch, error fan-back) counts as a dequeue for the gauge
+            self.on_depth(self.backlog())
         pending = list(batch)       # not yet resolved with a result/error
         try:
             from deeplearning4j_tpu import faults
 
             plan = faults.active()
-            if plan is not None and plan.fires("infer_crash"):
-                raise faults.InferenceWorkerCrash(
-                    "injected inference-worker crash")
+            if plan is not None:
+                if plan.fires("infer_crash") or plan.fires("worker_crash"):
+                    raise faults.InferenceWorkerCrash(
+                        "injected inference-worker crash")
+                if plan.fires("slow_worker"):
+                    time.sleep(plan.delay_s)
             # shed deadline-expired requests BEFORE dispatch: their callers
             # get an immediate DeadlineExceeded instead of riding (and
             # paying for) a device batch whose result nobody will read
             now = time.monotonic()
-            live, shed = [], 0
+            live, shed = [], {}
             for item in batch:
                 if item[2] is not None and now > item[2]:
                     item[1].put(DeadlineExceeded(
                         "deadline passed before dispatch"))
                     pending.remove(item)
-                    shed += 1
+                    shed[item[3]] = shed.get(item[3], 0) + 1
                 else:
                     live.append(item)
             if shed and self.on_shed is not None:
-                self.on_shed(shed)
+                for klass, n in shed.items():
+                    self.on_shed(n, klass)
             if not live:
                 return
             mon = monitoring.serving_monitor()
             if mon is not None:
                 # batch-size distribution + queue backlog at dispatch time
                 mon.batch_size.observe(len(live))
-                mon.queue_depth.set(self._q.qsize())
+                mon.queue_depth.set(self.backlog())
             xs = np.stack([b[0] for b in live])
             n = xs.shape[0]
             if self.pad_batches and n > 1:

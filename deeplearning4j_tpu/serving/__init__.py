@@ -13,9 +13,16 @@ Grew out of the single-model ``serving.py`` (kept importable here unchanged:
 - :mod:`~deeplearning4j_tpu.serving.warmup` — pad-to-bucket batch shapes
   precompiled at model load, so no request pays a cold XLA compile;
 - :mod:`~deeplearning4j_tpu.serving.http` — stdlib JSON-over-HTTP
-  scaffolding (+ ``GET /metrics`` Prometheus exposition on every server).
+  scaffolding (+ ``GET /metrics`` Prometheus exposition on every server);
+- :mod:`~deeplearning4j_tpu.serving.tenancy` — API keys, priority classes
+  (``interactive`` > ``default`` > ``batch``), sliding-window quotas;
+- :mod:`~deeplearning4j_tpu.serving.slo` — per-class latency objectives,
+  burn rate, shed-lowest-class-first overload policy, ``GET /slo``;
+- :mod:`~deeplearning4j_tpu.serving.autoscale` — backlog-driven replica
+  autoscaling of each model's ParallelInference worker pool.
 
-See ``docs/serving.md`` for routes, admission knobs, and a canary example.
+See ``docs/serving.md`` for routes, admission knobs, and a canary example;
+``docs/slo.md`` for the multi-tenant/SLO runbook.
 """
 
 # Lazy re-exports (PEP 562): the generation engine imports
@@ -26,6 +33,12 @@ See ``docs/serving.md`` for routes, admission knobs, and a canary example.
 _EXPORTS = {
     "AdmissionController": "deeplearning4j_tpu.serving.admission",
     "ServingGateway": "deeplearning4j_tpu.serving.gateway",
+    "Tenant": "deeplearning4j_tpu.serving.tenancy",
+    "TenantTable": "deeplearning4j_tpu.serving.tenancy",
+    "QuotaExceeded": "deeplearning4j_tpu.serving.tenancy",
+    "PRIORITY_CLASSES": "deeplearning4j_tpu.serving.tenancy",
+    "SloTracker": "deeplearning4j_tpu.serving.slo",
+    "ReplicaAutoscaler": "deeplearning4j_tpu.serving.autoscale",
     "HttpError": "deeplearning4j_tpu.serving.http",
     "serve_json": "deeplearning4j_tpu.serving.http",
     "_serve_json": "deeplearning4j_tpu.serving.http",
@@ -42,6 +55,8 @@ _EXPORTS = {
 __all__ = [
     "ServingGateway", "ModelRegistry", "ModelVersion",
     "AdmissionController", "HttpError", "serve_json",
+    "Tenant", "TenantTable", "QuotaExceeded", "PRIORITY_CLASSES",
+    "SloTracker", "ReplicaAutoscaler",
     "ModelServer", "KNNServer",
     "pow2_buckets", "bucket_for", "warmup_model",
 ]

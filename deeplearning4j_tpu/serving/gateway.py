@@ -19,11 +19,32 @@ One HTTP server multiplexing many named, versioned models:
                               died/was self-heal restarted)
     GET  /readyz              traffic readiness (503 until a model is
                               loaded, and again once draining)
+    GET  /slo                 per-class SLO status: objective, burn rate,
+                              and whether the class is currently shedding
+                              ({"enabled": false} without SLO config)
     GET  /metrics             Prometheus exposition (process-wide registry)
 
 Admission outcomes a client sees: 200 (served), 429 + ``Retry-After``
-(queue full — back off), 503 (no servable model, or draining), 504
-(deadline exceeded), 500 (model forward failed), 404 (unknown model).
+(queue full, over quota, or shed for a burning higher class — back off),
+503 (no servable model, or draining), 504 (deadline exceeded), 500 (model
+forward failed), 404 (unknown model), 401 (multi-tenant mode, bad/missing
+API key).
+
+Multi-tenant mode (all opt-in; see docs/slo.md):
+
+- ``tenants=[Tenant(...)]`` — API-key auth, priority classes
+  (``interactive`` > ``default`` > ``batch``; batch rides the workers'
+  low-priority lane), sliding-window request/token quotas (429 with a
+  drain-aware ``Retry-After``);
+- ``slo={"interactive": {"objective_ms": 250, "target": 0.95}, ...}`` —
+  per-class latency objectives with shed-lowest-class-first overload
+  behavior and the ``GET /slo`` burn-rate surface;
+- ``autoscale={"max_replicas": 4, ...}`` — backlog-driven replica
+  autoscaling of every model's worker pool, started/stopped with the
+  gateway lifecycle.
+
+None of the three configured = none of the machinery built: the request
+path does zero tenancy/SLO/priority bookkeeping (spy-guarded contract).
 
 Lifecycle: ``stop()`` is a graceful drain — stop admitting (``/readyz``
 goes 503 so balancers eject the instance), wait for in-flight requests,
@@ -73,7 +94,8 @@ class ServingGateway(_HttpServerMixin):
                  default_timeout_s: float = 30.0,
                  retry_after_s: float = 1.0,
                  seed: Optional[int] = None, admin: bool = True,
-                 generate_max_queue: int = 64):
+                 generate_max_queue: int = 64,
+                 tenants=None, slo=None, autoscale=None):
         self._host, self._port = host, port
         self.admin = admin
         self.registry = ModelRegistry(
@@ -83,6 +105,28 @@ class ServingGateway(_HttpServerMixin):
             default_timeout_s=default_timeout_s,
             retry_after_s=retry_after_s)
         self.generate_max_queue = generate_max_queue
+        # multi-tenant tier: all three stay None unless configured, and
+        # every request-path touch point is a single None check — the
+        # zero-overhead contract
+        self.tenancy = None
+        if tenants is not None:
+            from deeplearning4j_tpu.serving.tenancy import TenantTable
+
+            self.tenancy = (tenants if isinstance(tenants, TenantTable)
+                            else TenantTable(tenants))
+        self.slo = None
+        if slo is not None:
+            from deeplearning4j_tpu.serving.slo import SloTracker
+
+            self.slo = slo if isinstance(slo, SloTracker) else SloTracker(slo)
+        self.autoscaler = None
+        if autoscale is not None:
+            from deeplearning4j_tpu.serving.autoscale import ReplicaAutoscaler
+
+            self.autoscaler = (autoscale
+                               if isinstance(autoscale, ReplicaAutoscaler)
+                               else ReplicaAutoscaler(self.registry,
+                                                      **autoscale))
         self._generators: dict = {}
         self._draining = False
         self._inflight = 0
@@ -127,6 +171,30 @@ class ServingGateway(_HttpServerMixin):
             if self._inflight == 0:
                 self._idle.notify_all()
 
+    def _admit_tenant(self, name: str, body: dict, headers, cost: int):
+        """The multi-tenant admission prelude shared by predict and
+        generate: authorize the API key, shed if a higher-priority class
+        is burning its SLO budget, then charge the quota. Returns the
+        tenant's priority class (None when tenancy is off — the
+        zero-overhead path does none of this)."""
+        tenant = klass = None
+        if self.tenancy is not None:
+            tenant = self.tenancy.authorize(body, headers)
+            klass = tenant.klass
+        if self.slo is not None and self.slo.should_shed(klass):
+            self.admission._shed(name, "slo", klass=klass)
+            raise HttpError(
+                429, f"shedding {klass or 'default'} traffic: a higher-"
+                "priority class is over its latency objective",
+                headers=self.admission._retry_headers())
+        if tenant is not None:
+            try:
+                self.tenancy.admit(tenant, tokens=cost)
+            except HttpError:
+                self.admission._shed(name, "quota", klass=klass)
+                raise
+        return klass
+
     def _predict(self, params, body):
         if self._draining:
             raise HttpError(503, "gateway is draining",
@@ -134,7 +202,7 @@ class ServingGateway(_HttpServerMixin):
         name = params["name"]
         self._track(+1)
         try:
-            return self._predict_inner(name, body)
+            return self._predict_inner(name, body, params.get("_headers"))
         finally:
             self._track(-1)
 
@@ -146,9 +214,11 @@ class ServingGateway(_HttpServerMixin):
         engine = self._generators.get(name)
         if engine is None:
             raise HttpError(404, f"generator {name!r} is not registered")
-        return handle_generate(self, engine, name, body)
+        klass = self._admit_tenant(name, body, params.get("_headers"),
+                                   cost=int(body.get("max_new_tokens", 64)))
+        return handle_generate(self, engine, name, body, klass=klass)
 
-    def _predict_inner(self, name: str, body: dict):
+    def _predict_inner(self, name: str, body: dict, headers=None):
         try:
             mv = self.registry.route(name)
         except KeyError:
@@ -156,13 +226,14 @@ class ServingGateway(_HttpServerMixin):
         xs = np.asarray(body["inputs"], np.float32)
         if xs.ndim < 1 or xs.shape[0] == 0:
             raise HttpError(400, "inputs must be a non-empty batch")
+        klass = self._admit_tenant(name, body, headers, cost=len(xs))
         timeout = self.admission.timeout_for(body)
         deadline = time.monotonic() + timeout
         t0 = time.perf_counter()
         code = 200
         try:
             try:
-                queues = self.admission.submit(mv, xs, deadline)
+                queues = self.admission.submit(mv, xs, deadline, klass=klass)
             except HttpError as e:
                 if e.code != 503:
                     raise
@@ -171,8 +242,8 @@ class ServingGateway(_HttpServerMixin):
                 # so the retry sees the replacement. This is what makes hot
                 # reload zero-drop.
                 mv = self.registry.route(name)
-                queues = self.admission.submit(mv, xs, deadline)
-            outs = self.admission.gather(mv, queues, deadline)
+                queues = self.admission.submit(mv, xs, deadline, klass=klass)
+            outs = self.admission.gather(mv, queues, deadline, klass=klass)
             return {"outputs": [y.tolist() for y in outs],
                     "model": mv.name, "version": mv.version}
         except HttpError as e:
@@ -182,11 +253,15 @@ class ServingGateway(_HttpServerMixin):
             code = 400
             raise
         finally:
+            elapsed = time.perf_counter() - t0
             mon = monitoring.serving_monitor()
             if mon is not None:
                 mon.model_request_seconds.labels(
-                    model=name, version=mv.version, code=code).observe(
-                    time.perf_counter() - t0)
+                    model=name, version=mv.version, code=code).observe(elapsed)
+            if self.slo is not None and code != 429:
+                # sheds don't spend latency budget; served outcomes —
+                # including 504s, which ARE objective misses — do
+                self.slo.observe(klass, elapsed)
 
     # ----------------------------------------------------- admin routes
     def _require(self, body: dict, *keys):
@@ -238,6 +313,13 @@ class ServingGateway(_HttpServerMixin):
             raise HttpError(503, "no model loaded")
         return {"ready": True, "models": self.registry.names()}
 
+    def _slo_route(self, _body):
+        """Per-class SLO status: objective, burn rate, shed state — the
+        operator's 'is batch being sacrificed right now, and why' view."""
+        if self.slo is None:
+            return {"enabled": False}
+        return dict(self.slo.status(), enabled=True)
+
     def _healthz(self, _body):
         """Liveness stays 200 (the process is up — restart-level health is
         the balancer's /readyz call), but the body surfaces self-healing
@@ -266,12 +348,15 @@ class ServingGateway(_HttpServerMixin):
             get_routes={
                 "/healthz": self._healthz,
                 "/readyz": self._readyz,
+                "/slo": self._slo_route,
                 "/models": lambda _: {"models": self.registry.describe()},
             },
             dynamic_post=[
                 ("/v1/*/predict", _match_predict, self._predict),
                 ("/v1/*/generate", match_generate, self._generate),
             ])
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0):
@@ -283,6 +368,9 @@ class ServingGateway(_HttpServerMixin):
         terminal ndjson line says ``finish_reason: "cancelled"``), never
         left to run headless. ``drain=False`` hard-stops."""
         self._draining = True
+        if self.autoscaler is not None:
+            # no replica churn while the workers are flushing their lanes
+            self.autoscaler.stop()
         end = time.monotonic() + timeout
         if drain:
             with self._inflight_lock:

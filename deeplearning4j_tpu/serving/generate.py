@@ -53,22 +53,28 @@ def _prompt_from(body: dict, engine):
     raise HttpError(400, "need prompt (string) or prompt_ids (list)")
 
 
-def handle_generate(gateway, engine, name: str, body: dict):
+def handle_generate(gateway, engine, name: str, body: dict,
+                    klass: Optional[str] = None):
     """The /v1/<name>/generate handler body, shared by the gateway.
 
     Returns either a plain dict (one-shot) or a StreamingResponse whose
     ``on_finish`` releases the gateway in-flight slot — which is what makes
     ``ServingGateway.stop()`` drain streams, not just one-shot requests.
+    ``klass`` is the caller's priority class (multi-tenant gateways):
+    ``batch`` requests wait in the engine's low-priority pending lane, so
+    interactive submissions claim freed slots first.
     """
     mon = monitoring.serving_monitor()
     gmon = monitoring.generate_monitor()
     if engine.pending_count() >= gateway.generate_max_queue:
         if mon is not None:
-            mon.shed_total.labels(model=name, reason="queue_full").inc()
+            mon.shed_total.labels(model=name, reason="queue_full",
+                                  **{"class": klass or "default"}).inc()
         if gmon is not None:
             gmon.requests_total.labels(outcome="shed").inc()
         raise HttpError(429, "generation queue is full",
-                        headers=gateway.admission._retry_headers())
+                        headers=gateway.admission._retry_headers(
+                            engine.pending_count()))
     prompt = _prompt_from(body, engine)
     try:
         stream = engine.submit(
@@ -78,7 +84,8 @@ def handle_generate(gateway, engine, name: str, body: dict):
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
             seed=int(body.get("seed", 0)),
-            eos_id=body.get("eos_id"))
+            eos_id=body.get("eos_id"),
+            klass=klass)
     except RuntimeError as e:  # engine shut down
         raise HttpError(503, str(e),
                         headers=gateway.admission._retry_headers()) from None

@@ -142,7 +142,12 @@ def serve_json(host, port, post_routes, get_routes,
             for label, match, handler in dynamic:
                 params = match(path)
                 if params is not None:
-                    return label, (lambda body, h=handler, p=params: h(p, body))
+                    # dynamic handlers get the request headers under
+                    # "_headers" (case-insensitive Message mapping) — the
+                    # tenancy layer reads X-Api-Key from here
+                    return label, (lambda body, h=handler, p=params,
+                                   hd=self.headers:
+                                   h(dict(p, _headers=hd), body))
             return path, None
 
         def _route(self, routes, dynamic, body):

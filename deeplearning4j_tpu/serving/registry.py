@@ -73,15 +73,26 @@ class ModelRegistry:
         limit = batch_limit or self.batch_limit
         mon = monitoring.serving_monitor()
 
-        def on_shed(n):
+        def on_shed(n, klass=None):
             m = monitoring.serving_monitor()
             if m is not None:
-                m.shed_total.labels(model=name, reason="deadline").inc(n)
+                m.shed_total.labels(model=name, reason="deadline",
+                                    **{"class": klass or "default"}).inc(n)
+
+        def on_depth(backlog):
+            # fires on EVERY dequeue path — normal dispatch and deadline
+            # sheds alike — so the per-model queue-depth gauge decays when
+            # expired requests are dropped instead of freezing at its last
+            # submit-time value (the gauge-leak fix)
+            m = monitoring.serving_monitor()
+            if m is not None:
+                m.model_queue_depth.labels(model=name,
+                                           version=version).set(backlog)
 
         pi = ParallelInference(
             model, batch_limit=limit, queue_timeout_s=self.queue_timeout_s,
             max_queue=self.max_queue if max_queue is None else max_queue,
-            on_shed=on_shed).start()
+            on_shed=on_shed, on_depth=on_depth).start()
         buckets = pow2_buckets(limit)
         timings: Dict[int, float] = {}
         if warmup and warmup_shape is not None:
@@ -89,6 +100,8 @@ class ModelRegistry:
                                    labels=(name, version))
         if mon is not None:
             mon.model_loaded.labels(model=name, version=version).set(1)
+            mon.replicas.labels(model=name, version=version).set(
+                pi.replicas())
         return ModelVersion(name, version, model, pi, buckets, timings)
 
     def load(self, name: str, version: str, model, *,

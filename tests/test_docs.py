@@ -58,6 +58,20 @@ def _mk_model(seed):
 
 model, stable, candidate = _mk_model(0), _mk_model(1), _mk_model(2)
 """,
+    "slo.md": """
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+_conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(lr=0.1))
+         .list()
+         .layer(DenseLayer(n_out=4, activation="relu"))
+         .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+         .set_input_type(InputType.feed_forward(16)).build())
+model = MultiLayerNetwork(_conf).init()
+""",
     "quantization.md": """
 import numpy as np
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration

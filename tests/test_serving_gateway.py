@@ -44,11 +44,11 @@ class StubModel:
         return x * self.scale
 
 
-def _post(base, path, payload, timeout=30):
+def _post(base, path, payload, timeout=30, headers=None):
     """POST helper returning (status, body-dict, headers)."""
     req = urllib.request.Request(
         base + path, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         r = urllib.request.urlopen(req, timeout=timeout)
         return r.status, json.loads(r.read()), dict(r.headers)
@@ -191,8 +191,9 @@ class TestAdmissionControl:
             # ~1.6 s if everything piled up; shedding keeps it well under
             assert elapsed < 10.0
             shed = monitoring.registry().get("dl4j_serving_shed_total")
-            assert shed.labels(model="slow",
-                               reason="queue_full").value == codes.count(429)
+            assert shed.labels(model="slow", reason="queue_full",
+                               **{"class": "default"}).value == \
+                codes.count(429)
         finally:
             gw.stop()
 
@@ -511,5 +512,335 @@ class TestGatewayEndToEndSlow:
             assert first < max(20 * steady, 1.0), (
                 f"first request {first:.3f}s vs steady {steady:.4f}s — "
                 "compile on the request path?")
+        finally:
+            gw.stop()
+
+
+# --------------------------------------------------------------------------
+# PR 11: multi-tenant gateway — API keys, quotas, priority classes, SLOs
+# --------------------------------------------------------------------------
+
+TENANTS = [
+    {"key": "key-int", "name": "alice", "klass": "interactive",
+     "requests_per_window": 100},
+    {"key": "key-bat", "name": "bob", "klass": "batch",
+     "tokens_per_window": 4, "window_s": 60.0},
+]
+
+
+class TestMultiTenant:
+    def test_auth_required_and_quota_shed(self, metrics_on):
+        gw = ServingGateway(port=0, seed=0, tenants=TENANTS).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("m", "v1", StubModel(scale=2.0), warmup=False)
+            x = {"inputs": [[1.0, 2.0]]}
+            # no key -> 401; unknown key -> 401
+            code, body, _ = _post(base, "/v1/m/predict", x)
+            assert code == 401 and "API key" in body["error"]
+            code, _, _ = _post(base, "/v1/m/predict", x,
+                               headers={"X-Api-Key": "nope"})
+            assert code == 401
+            # header auth and body auth both work
+            code, body, _ = _post(base, "/v1/m/predict", x,
+                                  headers={"X-Api-Key": "key-int"})
+            assert code == 200 and body["outputs"] == [[2.0, 4.0]]
+            code, _, _ = _post(base, "/v1/m/predict",
+                               dict(x, api_key="key-int"))
+            assert code == 200
+            # bob's token quota is 4/window; each row costs one token
+            code, _, _ = _post(base, "/v1/m/predict",
+                               {"inputs": [[1.0, 2.0]] * 4,
+                                "api_key": "key-bat"})
+            assert code == 200
+            code, body, hdrs = _post(base, "/v1/m/predict",
+                                     dict(x, api_key="key-bat"))
+            assert code == 429 and "quota" in body["error"]
+            assert 1 <= int(hdrs["Retry-After"]) <= 30
+            text = monitoring.registry().exposition()
+            assert ('dl4j_serving_shed_total{model="m",reason="quota",'
+                    'class="batch"} 1') in text
+            assert ('dl4j_tenant_requests_total{tenant="bob",'
+                    'outcome="quota_tokens"} 1') in text
+        finally:
+            gw.stop()
+
+    def test_priority_lane_served_before_batch(self):
+        from deeplearning4j_tpu.parallel.inference import resolve
+        order = []
+        lock = threading.Lock()
+
+        class Recorder:
+            def output(self, x):
+                x = np.asarray(x)
+                with lock:
+                    order.extend(float(v) for v in x[:, 0])
+                time.sleep(0.15)
+                return x
+
+        from deeplearning4j_tpu.parallel import ParallelInference
+        pi = ParallelInference(Recorder(), batch_limit=1,
+                               queue_timeout_s=0.001).start()
+        try:
+            qs = [pi.submit(np.zeros(2))]      # occupies the worker
+            time.sleep(0.05)                   # worker now inside output()
+            qs += [pi.submit(np.full(2, 10.0 + i), klass="batch")
+                   for i in range(3)]
+            qs += [pi.submit(np.full(2, 1.0 + i)) for i in range(2)]
+            for q in qs:
+                resolve(q.get(timeout=30))
+            # interactive lane drains fully before the batch lane
+            assert order[0] == 0.0
+            assert order[1:3] == [1.0, 2.0]
+            assert order[3:] == [10.0, 11.0, 12.0]
+        finally:
+            pi.stop(drain=False)
+
+    def test_slo_sheds_lowest_class_first(self, metrics_on):
+        from deeplearning4j_tpu.serving import SloTracker
+        slo = SloTracker({"interactive": {"objective_ms": 1, "target": 0.5}},
+                         min_samples=2)
+        gw = ServingGateway(port=0, seed=0, tenants=TENANTS,
+                            slo=slo).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("m", "v1", StubModel(), warmup=False)
+            # burn the interactive budget: every sample violates 1ms
+            for _ in range(4):
+                gw.slo.observe("interactive", 1.0)
+            assert gw.slo.should_shed("batch")
+            assert not gw.slo.should_shed("interactive")
+            x = {"inputs": [[1.0, 2.0]]}
+            code, body, _ = _post(base, "/v1/m/predict",
+                                  dict(x, api_key="key-bat"))
+            assert code == 429 and "higher-priority" in body["error"]
+            code, _, _ = _post(base, "/v1/m/predict",
+                               dict(x, api_key="key-int"))
+            assert code == 200   # the burning class itself keeps serving
+            text = monitoring.registry().exposition()
+            assert ('dl4j_serving_shed_total{model="m",reason="slo",'
+                    'class="batch"} 1') in text
+            # /slo reports the burn
+            code, raw = _get(base, "/slo")
+            assert code == 200
+            status = json.loads(raw)
+            assert status["enabled"]
+            assert status["classes"]["interactive"]["burn_rate"] > 1.0
+            assert status["classes"]["interactive"]["shedding"] is False
+            assert status["priority_order"] == ["interactive", "default",
+                                                "batch"]
+        finally:
+            gw.stop()
+
+    def test_retry_after_tracks_drain_rate(self):
+        from deeplearning4j_tpu.serving import AdmissionController
+        adm = AdmissionController(retry_after_s=2.0)
+        # before any observation: the configured constant
+        assert adm.retry_after_for(None) == 2
+        assert adm.retry_after_for(5) == 2
+        adm.observe_service(2.0)             # EWMA seeds at first sample
+        assert adm.retry_after_for(5) == 10  # 2.0s/req x position 5
+        assert adm.retry_after_for(1) == 2
+        assert adm.retry_after_for(1000) == 30   # clamped
+        for _ in range(40):                      # drain rate speeds up...
+            adm.observe_service(0.001)
+        assert adm.retry_after_for(1) == 1       # ...and the hint follows
+        assert adm._ewma_service_s < 0.1
+
+    def test_shed_decrements_queue_depth_gauge(self, metrics_on):
+        """Regression: deadline-shed requests must decrement the queue-depth
+        gauge — it used to be written only at submit, so sheds left it
+        permanently inflated."""
+        from deeplearning4j_tpu.parallel.inference import DeadlineExceeded
+        gw = ServingGateway(port=0, seed=0, queue_timeout_s=0.001)
+        mv = gw.register_model("m", "v1", StubModel(delay=0.1),
+                               warmup=False, batch_limit=1)
+        try:
+            gauge = monitoring.registry().get("dl4j_serving_model_queue_depth")
+            q0 = mv.pi.submit(np.ones(2))          # occupies the worker
+            time.sleep(0.03)
+            dead = [mv.pi.submit(np.ones(2), deadline=time.monotonic() - 1.0)
+                    for _ in range(3)]
+            assert mv.pi.backlog() == 3
+            for q in dead:
+                assert isinstance(q.get(timeout=30), DeadlineExceeded)
+            q0.get(timeout=30)
+            deadline = time.monotonic() + 5
+            while (gauge.labels(model="m", version="v1").value != 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert gauge.labels(model="m", version="v1").value == 0
+            shed = monitoring.registry().get("dl4j_serving_shed_total")
+            assert shed.labels(model="m", reason="deadline",
+                               **{"class": "default"}).value == 3
+        finally:
+            gw.registry.shutdown()
+
+    def test_autoscaler_hysteresis_and_bounds(self, metrics_on):
+        from deeplearning4j_tpu.serving import ReplicaAutoscaler
+        gw = ServingGateway(port=0, seed=0, queue_timeout_s=0.001)
+        mv = gw.register_model("m", "v1", StubModel(delay=0.02),
+                               warmup=False, batch_limit=1)
+        asc = ReplicaAutoscaler(gw.registry, max_replicas=3,
+                                high_backlog=2.0, low_backlog=1.0,
+                                scale_up_after=2, scale_down_after=3)
+        try:
+            assert mv.pi.replicas() == 1
+            qs = [mv.pi.submit(np.ones(2)) for _ in range(20)]
+            d1 = asc.tick()["m/v1"]
+            assert d1["scaled"] is None          # hysteresis: 1 tick < 2
+            d2 = asc.tick()["m/v1"]
+            assert d2["scaled"] == "up" and d2["replicas"] == 2
+            for q in qs:
+                q.get(timeout=30)
+            # backlog gone: scale down only after 3 consecutive low ticks
+            assert asc.tick()["m/v1"]["scaled"] is None
+            assert asc.tick()["m/v1"]["scaled"] is None
+            d5 = asc.tick()["m/v1"]
+            assert d5["scaled"] == "down" and d5["replicas"] == 1
+            # wait for the retired worker to exit, then keep ticking:
+            # never below min_replicas, whatever the streak
+            deadline = time.monotonic() + 5
+            while mv.pi.replicas() > 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert mv.pi.replicas() == 1
+            for _ in range(6):
+                assert asc.tick()["m/v1"]["scaled"] != "down"
+            assert mv.pi._target == 1
+            text = monitoring.registry().exposition()
+            assert ('dl4j_serving_autoscale_total{model="m",version="v1",'
+                    'direction="up"} 1') in text
+            assert ('dl4j_serving_autoscale_total{model="m",version="v1",'
+                    'direction="down"} 1') in text
+        finally:
+            gw.registry.shutdown()
+
+    def test_unconfigured_gateway_makes_zero_tenancy_calls(self, monkeypatch):
+        """Zero-overhead contract: with no tenants/slo/autoscale configured
+        and monitoring off, a full HTTP predict makes ZERO metric writes and
+        ZERO tenancy/slo calls (spy-guarded, same style as
+        test_monitoring.py)."""
+        from deeplearning4j_tpu.monitoring.registry import (Counter, Gauge,
+                                                            Histogram)
+        from deeplearning4j_tpu.serving import slo as slo_mod
+        from deeplearning4j_tpu.serving import tenancy as tenancy_mod
+        assert not monitoring.enabled()
+        calls = []
+
+        def spy(name):
+            def record(self, *a, **kw):
+                calls.append(name)
+            return record
+
+        monkeypatch.setattr(Counter, "inc", spy("Counter.inc"))
+        monkeypatch.setattr(Gauge, "set", spy("Gauge.set"))
+        monkeypatch.setattr(Gauge, "inc", spy("Gauge.inc"))
+        monkeypatch.setattr(Gauge, "dec", spy("Gauge.dec"))
+        monkeypatch.setattr(Histogram, "observe", spy("Histogram.observe"))
+        monkeypatch.setattr(tenancy_mod.TenantTable, "authorize",
+                            spy("TenantTable.authorize"))
+        monkeypatch.setattr(tenancy_mod.TenantTable, "admit",
+                            spy("TenantTable.admit"))
+        monkeypatch.setattr(slo_mod.SloTracker, "observe",
+                            spy("SloTracker.observe"))
+        monkeypatch.setattr(slo_mod.SloTracker, "should_shed",
+                            spy("SloTracker.should_shed"))
+        gw = ServingGateway(port=0, seed=0).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            assert gw.tenancy is None
+            assert gw.slo is None
+            assert gw.autoscaler is None
+            gw.register_model("m", "v1", StubModel(), warmup=False)
+            code, body, _ = _post(base, "/v1/m/predict",
+                                  {"inputs": [[1.0, 2.0]]})
+            assert code == 200 and body["outputs"] == [[1.0, 2.0]]
+            code, raw = _get(base, "/slo")
+            assert code == 200 and json.loads(raw) == {"enabled": False}
+        finally:
+            gw.stop()
+        assert calls == []
+
+
+class TestMixedPriorityDrain:
+    def test_drain_mixed_classes_with_injected_crash(self, metrics_on):
+        """stop() under mixed priorities + an injected worker crash:
+        admitted work (both classes) resolves, the crash victim gets a
+        terminal 500 (not a hang), late arrivals get 503, and no queue
+        slots leak."""
+        from deeplearning4j_tpu import faults
+        gw = ServingGateway(port=0, seed=0, batch_limit=1,
+                            queue_timeout_s=0.001, tenants=TENANTS).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        mv = gw.register_model("slow", "v1", StubModel(delay=0.2),
+                               warmup=False, batch_limit=1)
+        results = {}
+
+        def fire(tag, key):
+            code, _, _ = _post(base, "/v1/slow/predict",
+                               {"inputs": [[1.0, 2.0]], "api_key": key})
+            results[tag] = code
+
+        t_int = threading.Thread(target=fire, args=("inflight", "key-int"))
+        t_int.start()
+        time.sleep(0.1)            # interactive request now inside output()
+        with faults.injected("infer_crash:1") as plan:
+            t_b = [threading.Thread(target=fire, args=(f"qb{i}", "key-bat"))
+                   for i in range(2)]
+            for t in t_b:
+                t.start()
+            time.sleep(0.05)
+            stopper = threading.Thread(target=gw.stop)
+            stopper.start()
+            time.sleep(0.05)
+            t_late = threading.Thread(target=fire, args=("late", "key-bat"))
+            t_late.start()
+            for t in [t_int, *t_b, t_late, stopper]:
+                t.join(timeout=30)
+                assert not t.is_alive()
+            assert plan.injected["infer_crash"] == 1
+        assert results["inflight"] == 200
+        # one queued batch request rode the crashed batch -> terminal 500,
+        # the other was served after the self-heal restart
+        assert sorted([results["qb0"], results["qb1"]]) == [200, 500]
+        assert results["late"] == 503
+        assert mv.pi.backlog() == 0
+
+
+class TestChaosSmoke:
+    def test_worker_crash_and_traffic_spike(self, metrics_on):
+        """Tier-1 chaos smoke: arm worker_crash + traffic_spike through a
+        tiny gateway; the spike multiplies the offered load, the crash is
+        self-healed, and the gateway keeps answering."""
+        from deeplearning4j_tpu import faults
+        gw = ServingGateway(port=0, seed=0, batch_limit=2, max_queue=64,
+                            tenants=TENANTS,
+                            slo={"interactive": {"objective_ms": 5000}},
+                            ).start()
+        base = f"http://127.0.0.1:{gw.port}"
+        try:
+            gw.register_model("m", "v1", StubModel(delay=0.005),
+                              warmup=False)
+            codes = []
+            with faults.injected("worker_crash:1;traffic_spike:1") as plan:
+                for _ in range(6):
+                    burst = 3 if plan.fires("traffic_spike") else 1
+                    for _ in range(burst):
+                        code, _, _ = _post(
+                            base, "/v1/m/predict",
+                            {"inputs": [[1.0, 2.0]], "api_key": "key-int"})
+                        codes.append(code)
+                assert plan.injected["worker_crash"] == 1
+                assert plan.injected["traffic_spike"] == 1
+            assert codes.count(500) == 1      # exactly the injected crash
+            assert codes.count(200) == len(codes) - 1
+            # self-healed: serving again, restart accounted
+            code, body, _ = _post(base, "/v1/m/predict",
+                                  {"inputs": [[3.0, 4.0]],
+                                   "api_key": "key-int"})
+            assert code == 200 and body["outputs"] == [[3.0, 4.0]]
+            text = monitoring.registry().exposition()
+            assert ('dl4j_recovery_total{component="serving",'
+                    'outcome="worker_restarted"} 1') in text
         finally:
             gw.stop()
