@@ -1716,7 +1716,13 @@ def bench_chaos(interactive_clients=6, batch_clients=10,
     Acceptance (reported in the artifact): interactive p99 stays within
     its objective through the chaos phase while the batch class sheds
     (429s) > 0, and the per-class ``dl4j_serving_shed_total`` deltas
-    witness shed-lowest-class-first."""
+    witness shed-lowest-class-first.
+
+    Observability hook (PR 12): the gateway runs traced and the flight
+    recorder is armed for the whole lane, so every admit / shed / crash /
+    autoscale / fault-injection incident of the chaos phase lands in the
+    ring; the bundle is force-dumped to ``FLIGHT_chaos.json`` next to the
+    BENCH artifact and its path is reported in the lane result."""
     import json as _json
     import threading
     import urllib.error
@@ -1725,6 +1731,7 @@ def bench_chaos(interactive_clients=6, batch_clients=10,
     import numpy as np
 
     from deeplearning4j_tpu import faults, monitoring
+    from deeplearning4j_tpu.monitoring import flight
     from deeplearning4j_tpu.nn import (
         InputType, MultiLayerNetwork, NeuralNetConfiguration,
     )
@@ -1732,6 +1739,9 @@ def bench_chaos(interactive_clients=6, batch_clients=10,
     from deeplearning4j_tpu.serving import ServingGateway
 
     monitoring.enable()
+    # ring only (no dump_dir): trigger kinds accumulate instead of writing
+    # one bundle per crash; the single postmortem is force-dumped below
+    flight.configure(enabled=True, capacity=2048)
     conf = (NeuralNetConfiguration.builder().seed(0).list()
             .layer(DenseLayer(n_out=64, activation="relu"))
             .layer(OutputLayer(n_out=8, activation="softmax",
@@ -1762,7 +1772,8 @@ def bench_chaos(interactive_clients=6, batch_clients=10,
                   "klass": "batch"}],
         slo={"interactive": {"objective_ms": objective_ms, "target": 0.99}},
         autoscale={"max_replicas": 2, "high_backlog": 4.0,
-                   "scale_up_after": 2, "interval_s": 0.1}).start()
+                   "scale_up_after": 2, "interval_s": 0.1},
+        trace=True).start()
     base = f"http://127.0.0.1:{gw.port}"
     mv = gw.register_model("mlp", "v1", model, warmup_shape=(32,),
                            batch_limit=4)
@@ -1848,8 +1859,20 @@ def bench_chaos(interactive_clients=6, batch_clients=10,
             chaos = run_phase("chaos", plan=plan)
             injected = dict(plan.injected)
         replicas_final = mv.pi.replicas()
+        # PR 12: the chaos lane's black box, next to the BENCH artifact —
+        # every admit/shed/crash/autoscale/fault event of the run, plus a
+        # metrics snapshot, in one Perfetto-adjacent postmortem bundle
+        flight_bundle, flight_events = None, 0
+        rec = flight.recorder()
+        if rec is not None:
+            here = os.path.dirname(os.path.abspath(__file__))
+            flight_bundle = rec.dump(
+                "chaos_lane", force=True,
+                path=os.path.join(here, "FLIGHT_chaos.json"))
+            flight_events = rec.describe(tail=1)["recorded_total"]
     finally:
         gw.stop()
+        flight.reset()
     chaos_shed = chaos["shed_delta_by_class"]
     return {
         "model": "dense MLP 32->64->8 (multi-tenant gateway)",
@@ -1857,6 +1880,8 @@ def bench_chaos(interactive_clients=6, batch_clients=10,
         "steady": steady,
         "chaos": chaos,
         "faults_injected": injected,
+        "flight_bundle": flight_bundle,
+        "flight_events_recorded": flight_events,
         "replicas_final": replicas_final,
         "acceptance": {
             "interactive_p99_within_objective":

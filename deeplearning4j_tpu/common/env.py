@@ -76,6 +76,20 @@ class Environment:
     # Persistent XLA compilation cache directory (monitoring/compile.py
     # wires it plus the dl4j_compile_* metrics tier). Unset = no cache.
     COMPILE_CACHE = "DL4J_TPU_COMPILE_CACHE"
+    # SpanTracer ring-buffer capacity: oldest events are dropped (and
+    # counted in dl4j_trace_events_dropped_total) past this many, so a
+    # long-running gateway with tracing armed holds memory flat.
+    TRACE_MAX_EVENTS = "DL4J_TPU_TRACE_MAX_EVENTS"
+    # Request tracing on serving gateways built without an explicit
+    # ``trace=`` argument (monitoring/context.py). Unset/0 = the request
+    # path performs zero tracer calls (spy-guarded contract).
+    TRACING = "DL4J_TPU_TRACING"
+    # Black-box flight recorder (monitoring/flight.py): =1 arms the
+    # process-wide ring buffer of serving/training incidents; the DIR
+    # variant also sets where trigger conditions dump postmortem bundles.
+    FLIGHT = "DL4J_TPU_FLIGHT"
+    FLIGHT_DIR = "DL4J_TPU_FLIGHT_DIR"
+    FLIGHT_CAP = "DL4J_TPU_FLIGHT_CAP"
 
     def __init__(self) -> None:
         self.reload()
@@ -94,6 +108,12 @@ class Environment:
         self.pad_tail = _flag(self.PAD_TAIL, True)
         self.compile_cache_dir = (os.environ.get(self.COMPILE_CACHE)
                                   or "").strip() or None
+        self.trace_max_events = max(1, _int(self.TRACE_MAX_EVENTS, 100_000))
+        self.tracing = _flag(self.TRACING)
+        self.flight = _flag(self.FLIGHT)
+        self.flight_dir = (os.environ.get(self.FLIGHT_DIR)
+                           or "").strip() or None
+        self.flight_cap = max(1, _int(self.FLIGHT_CAP, 512))
 
 
 env = Environment()

@@ -222,6 +222,11 @@ class FaultPlan:
             mon = monitoring.recovery_monitor()
             if mon is not None:
                 mon.faults_injected.labels(cls=cls).inc()
+            rec = monitoring.flight.recorder()
+            if rec is not None:
+                rec.record("fault_injected", cls=cls,
+                           **{k: v for k, v in ctx.items()
+                              if isinstance(v, (int, float, str))})
         return hit
 
     def describe(self) -> dict:

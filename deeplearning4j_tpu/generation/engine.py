@@ -90,6 +90,10 @@ class GenerationStream:
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        #: RequestTrace riding this stream (traced gateways); the engine
+        #: records queue_wait / prefill / decode spans into it. None = the
+        #: engine performs zero trace calls for this stream.
+        self.trace = None
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._cancelled = False
         self._last_at: Optional[float] = None
@@ -383,10 +387,13 @@ class GenerationEngine:
                max_new_tokens: int = 32, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               klass: Optional[str] = None) -> GenerationStream:
+               klass: Optional[str] = None,
+               trace=None) -> GenerationStream:
         """Queue a request; returns its token stream immediately.
         ``klass="batch"`` rides the low-priority pending lane — freed
-        slots go to interactive/default requests first."""
+        slots go to interactive/default requests first. ``trace`` (if any)
+        is attached BEFORE the stream is enqueued, so the engine loop never
+        races a late trace assignment."""
         if isinstance(prompt, str):
             if self.codec is None:
                 raise ValueError("string prompt needs a codec")
@@ -411,6 +418,7 @@ class GenerationEngine:
             top_p=float(top_p), seed=int(seed),
             eos_id=self.eos_id if eos_id is None else eos_id)
         stream = GenerationStream(req)
+        stream.trace = trace
         with self._cond:
             if not self._accepting:
                 raise RuntimeError("engine is shut down")
@@ -468,12 +476,28 @@ class GenerationEngine:
                 slot, sub, token=ids[-1], pos=len(ids) - 1, seed=req.seed,
                 temperature=req.temperature, top_k=req.top_k,
                 top_p=req.top_p, meta=stream)
+            t1 = time.monotonic()
             mon = monitoring.generate_monitor()
             if mon is not None:
-                mon.prefill_seconds.observe(time.monotonic() - t0)
+                mon.prefill_seconds.observe(t1 - t0)
+            if stream.trace is not None:
+                # queue_wait is retroactive (submit -> slot grant), exact
+                # because both ends are monotonic instants
+                stream.trace.add_span("queue_wait", stream.submitted_at, t0)
+                stream.trace.add_span("prefill", t0, t1,
+                                      prompt_len=len(ids))
+                stream.trace.event("admit", slot=slot)
 
     def _finish_stream(self, stream: GenerationStream, reason: str) -> None:
         stream._finish(reason)
+        if stream.trace is not None:
+            if stream.first_token_at is not None:
+                # the aggregate decode span: first token -> finish, one
+                # span regardless of token count
+                stream.trace.add_span("decode", stream.first_token_at,
+                                      stream.finished_at,
+                                      tokens=len(stream.tokens))
+            stream.trace.event("retire", reason=reason)
         mon = monitoring.generate_monitor()
         if mon is not None:
             mon.requests_total.labels(outcome=reason).inc()
@@ -514,7 +538,10 @@ class GenerationEngine:
             stream._emit(tok)
             if mon is not None:
                 if stream.first_token_at is None:
-                    mon.ttft_seconds.observe(now - stream.submitted_at)
+                    mon.ttft_seconds.observe(
+                        now - stream.submitted_at,
+                        exemplar=({"trace_id": stream.trace.trace_id}
+                                  if stream.trace is not None else None))
                 elif stream._last_at is not None:
                     mon.inter_token_seconds.observe(now - stream._last_at)
             if stream.first_token_at is None:

@@ -32,6 +32,8 @@ import time
 from typing import Optional
 
 from deeplearning4j_tpu.common.env import env
+from deeplearning4j_tpu.monitoring import flight
+from deeplearning4j_tpu.monitoring.flight import FlightRecorder
 from deeplearning4j_tpu.monitoring.registry import (
     DEFAULT_BUCKETS, SIZE_BUCKETS, Counter, Gauge, Histogram, MetricFamily,
     MetricsRegistry,
@@ -87,11 +89,14 @@ def reset() -> None:
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
     _import_mon = _recovery_mon = _compile_mon = _generate_mon = None
     _quantize_mon = _tenant_mon = _slo_mon = None
+    flight.reset()
 
 
-def metrics_text() -> str:
-    """The Prometheus exposition body for GET /metrics."""
-    return _REGISTRY.exposition()
+def metrics_text(exemplars: bool = False) -> str:
+    """The Prometheus exposition body for GET /metrics (``exemplars=True``
+    appends OpenMetrics exemplars to histogram buckets — the
+    ``?exemplars=1`` scrape)."""
+    return _REGISTRY.exposition(exemplars=exemplars)
 
 
 # ---- tracing ------------------------------------------------------------
@@ -513,10 +518,14 @@ def slo_monitor() -> Optional[_SloMonitor]:
 
 
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
+from deeplearning4j_tpu.monitoring.context import (  # noqa: E402 (cycle: context imports this module)
+    RequestTrace, RequestTracer,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
     "SpanTracer", "MetricsListener", "DEFAULT_BUCKETS", "SIZE_BUCKETS",
+    "FlightRecorder", "RequestTrace", "RequestTracer", "flight",
     "registry", "enabled", "enable", "disable", "reset", "metrics_text",
     "start_tracing", "stop_tracing", "tracer", "span", "validate_nesting",
     "fit_monitor", "serving_monitor", "localsgd_monitor",

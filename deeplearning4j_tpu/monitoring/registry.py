@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # Latency-shaped default buckets (seconds), prometheus-client's defaults.
@@ -32,6 +33,13 @@ SIZE_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 def _escape_label(v: str) -> str:
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    # HELP text escaping per the text format: backslash and newline only
+    # (quotes are legal there). User-supplied strings otherwise corrupt
+    # the exposition into unparseable extra lines.
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(v: float) -> str:
@@ -99,14 +107,27 @@ class Histogram:
         self._counts = [0] * (len(bs) + 1)  # per-bucket, last = +Inf
         self._sum = 0.0
         self._count = 0
+        # OpenMetrics exemplars: bucket index -> (labels, value, wall ts).
+        # Only the LAST exemplar per bucket is kept — exactly enough to
+        # link a latency bucket back to a recent trace id.
+        self._exemplars: Dict[int, Tuple[Dict[str, str], float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(value)
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar:
+                self._exemplars[i] = (dict(exemplar), v, time.time())
+
+    def exemplars(self) -> Dict[int, Tuple[Dict[str, str], float, float]]:
+        """Per-bucket-index exemplars (non-cumulative indexing, last index
+        = +Inf), as rendered by ``exposition(exemplars=True)``."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         """(cumulative bucket counts incl. +Inf, sum, count)."""
@@ -191,8 +212,9 @@ class MetricFamily:
     def set(self, value: float):
         self._only().set(value)
 
-    def observe(self, value: float):
-        self._only().observe(value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None):
+        self._only().observe(value, exemplar=exemplar)
 
     @property
     def value(self) -> float:
@@ -256,22 +278,38 @@ class MetricsRegistry:
             return [self._families[n] for n in sorted(self._families)]
 
     # ---- exposition ------------------------------------------------------
-    def exposition(self) -> str:
-        """The whole registry in the Prometheus text format (0.0.4)."""
+    def exposition(self, exemplars: bool = False) -> str:
+        """The whole registry in the Prometheus text format (0.0.4).
+
+        ``exemplars=True`` appends OpenMetrics-style exemplars to histogram
+        bucket lines (``... 7 # {trace_id="ab12"} 0.031 1712345678.9``) —
+        only valid under the OpenMetrics content type, so the gateway gates
+        it behind ``GET /metrics?exemplars=1`` and the default scrape stays
+        plain 0.0.4.
+        """
         out: List[str] = []
         for fam in self.families():
             if fam.help:
-                out.append(f"# HELP {fam.name} {fam.help}")
+                out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             out.append(f"# TYPE {fam.name} {fam.kind}")
             for key, child in fam.children():
                 pairs = [f'{n}="{_escape_label(v)}"'
                          for n, v in zip(fam.label_names, key)]
                 if fam.kind == "histogram":
                     cum, s, c = child.snapshot()
+                    ex = child.exemplars() if exemplars else {}
                     bounds = [_fmt(b) for b in child.buckets] + ["+Inf"]
-                    for bound, n in zip(bounds, cum):
+                    for i, (bound, n) in enumerate(zip(bounds, cum)):
                         lbl = ",".join(pairs + [f'le="{bound}"'])
-                        out.append(f"{fam.name}_bucket{{{lbl}}} {n}")
+                        line = f"{fam.name}_bucket{{{lbl}}} {n}"
+                        if i in ex:
+                            elabels, ev, ets = ex[i]
+                            epairs = ",".join(
+                                f'{k}="{_escape_label(v)}"'
+                                for k, v in sorted(elabels.items()))
+                            line += (f" # {{{epairs}}} {_fmt(ev)} "
+                                     f"{ets:.3f}")
+                        out.append(line)
                     suffix = "{" + ",".join(pairs) + "}" if pairs else ""
                     out.append(f"{fam.name}_sum{suffix} {_fmt(s)}")
                     out.append(f"{fam.name}_count{suffix} {c}")

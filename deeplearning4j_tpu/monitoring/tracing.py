@@ -8,20 +8,33 @@ emitting thread's id), so serving worker threads and the fit loop interleave
 correctly on separate tracks.
 
 The output is the Chrome trace-event format — begin/end ("B"/"E") event
-pairs under ``{"traceEvents": [...]}`` — which Perfetto
-(https://ui.perfetto.dev) and chrome://tracing load directly. Timestamps
-are microseconds from tracer start (``perf_counter`` based, so spans are
-comparable across threads of this process).
+pairs, "X" complete events, and "M" metadata under ``{"traceEvents": [...]}``
+— which Perfetto (https://ui.perfetto.dev) and chrome://tracing load
+directly. Timestamps are microseconds from tracer start (``perf_counter``
+based, so spans are comparable across threads of this process).
+
+The event buffer is a RING: past ``max_events`` (constructor arg, else
+``DL4J_TPU_TRACE_MAX_EVENTS``, default 100k) the oldest events are dropped
+and counted — in ``.dropped`` and, when monitoring is enabled, in
+``dl4j_trace_events_dropped_total`` — so a long-running gateway with
+tracing armed holds memory flat instead of leaking its whole history.
+Metadata events (process_name, and a ``thread_name`` emitted automatically
+the first time each thread records an event, so Perfetto tracks read as
+``pi-mnist-0`` / ``dl4j-autoscaler`` instead of bare tids) live outside the
+ring: names survive however many payload events are dropped.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
+
+from deeplearning4j_tpu.common.env import env
 
 
 def _json_safe(v):
@@ -42,17 +55,48 @@ class SpanTracer:
         tracer.save("trace.json")   # open in Perfetto
     """
 
-    def __init__(self, process_name: str = "deeplearning4j_tpu") -> None:
+    def __init__(self, process_name: str = "deeplearning4j_tpu",
+                 max_events: Optional[int] = None) -> None:
         self._lock = threading.Lock()
-        self._events: List[Dict] = []
+        self._cap = max(1, int(max_events if max_events is not None
+                               else env.trace_max_events))
+        self._events: Deque[Dict] = collections.deque()
         self._t0 = time.perf_counter()
         self._pid = os.getpid()
-        self._events.append({
+        self._named_tids: set = set()
+        self._meta: List[Dict] = [{
             "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
-            "args": {"name": process_name}})
+            "args": {"name": process_name}}]
+        self.dropped = 0
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
+
+    def _append(self, ev: Dict) -> None:
+        """Ring append: names the emitting thread on first sight, evicts
+        (and counts) the oldest event at capacity."""
+        tid = ev.get("tid")
+        overflowed = False
+        with self._lock:
+            if tid and tid not in self._named_tids:
+                self._named_tids.add(tid)
+                self._meta.append({
+                    "name": "thread_name", "ph": "M", "pid": self._pid,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name}})
+            if len(self._events) >= self._cap:
+                self._events.popleft()
+                self.dropped += 1
+                overflowed = True
+            self._events.append(ev)
+        if overflowed:
+            from deeplearning4j_tpu import monitoring
+
+            if monitoring.enabled():
+                monitoring.registry().counter(
+                    "dl4j_trace_events_dropped_total",
+                    "Span-tracer ring-buffer events dropped at capacity",
+                ).inc()
 
     @contextlib.contextmanager
     def span(self, name: str, **args):
@@ -62,15 +106,12 @@ class SpanTracer:
                        "pid": self._pid, "tid": tid}
         if args:
             begin["args"] = {k: _json_safe(v) for k, v in args.items()}
-        with self._lock:
-            self._events.append(begin)
+        self._append(begin)
         try:
             yield self
         finally:
-            end = {"name": name, "ph": "E", "ts": self._now_us(),
-                   "pid": self._pid, "tid": tid}
-            with self._lock:
-                self._events.append(end)
+            self._append({"name": name, "ph": "E", "ts": self._now_us(),
+                          "pid": self._pid, "tid": tid})
 
     def instant(self, name: str, **args) -> None:
         """A zero-duration marker event (thread-scoped)."""
@@ -79,12 +120,24 @@ class SpanTracer:
                     "tid": threading.get_ident()}
         if args:
             ev["args"] = {k: _json_safe(v) for k, v in args.items()}
-        with self._lock:
-            self._events.append(ev)
+        self._append(ev)
+
+    def complete(self, name: str, dur_s: float, **args) -> None:
+        """Record an already-measured span (ended ~now, ``dur_s`` long) as
+        an "X" complete event — how request-trace spans
+        (monitoring/context.py) mirror into the process timeline without
+        holding the tracer lock for their whole duration."""
+        dur_us = max(0.0, float(dur_s)) * 1e6
+        ev: Dict = {"name": name, "ph": "X",
+                    "ts": max(0.0, self._now_us() - dur_us), "dur": dur_us,
+                    "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = {k: _json_safe(v) for k, v in args.items()}
+        self._append(ev)
 
     def events(self) -> List[Dict]:
         with self._lock:
-            return list(self._events)
+            return list(self._meta) + list(self._events)
 
     def clear(self) -> None:
         with self._lock:

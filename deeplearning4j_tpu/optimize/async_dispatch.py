@@ -40,6 +40,7 @@ import numpy as np
 
 from deeplearning4j_tpu import monitoring
 from deeplearning4j_tpu.common.env import env
+from deeplearning4j_tpu.monitoring import context as trace_context
 
 
 def _fetch_scalar(arr) -> float:
@@ -50,13 +51,20 @@ def _fetch_scalar(arr) -> float:
 
 class AsyncStepError(RuntimeError):
     """An in-flight train step failed; raised at drain time with the step
-    it belongs to (not the step the host had reached when it surfaced)."""
+    it belongs to (not the step the host had reached when it surfaced).
+    ``trace_id`` names the request trace that DISPATCHED the step (ambient
+    :func:`monitoring.context.bind` at submit time), so a deferred failure
+    is still attributable to the window that caused it."""
 
-    def __init__(self, step: int, epoch: int, cause: BaseException):
-        super().__init__(
-            f"async train step {step} (epoch {epoch}) failed: {cause}")
+    def __init__(self, step: int, epoch: int, cause: BaseException,
+                 trace_id: Optional[str] = None):
+        msg = f"async train step {step} (epoch {epoch}) failed: {cause}"
+        if trace_id:
+            msg += f" [trace {trace_id}]"
+        super().__init__(msg)
         self.step = step
         self.epoch = epoch
+        self.trace_id = trace_id
         self.__cause__ = cause
 
 
@@ -70,12 +78,15 @@ class ScoreHandle:
     simply opts back into the sync point it was already paying for.
     """
 
-    __slots__ = ("_window", "step", "epoch", "_value", "_error")
+    __slots__ = ("_window", "step", "epoch", "trace_id", "_value", "_error")
 
     def __init__(self, window: "AsyncScoreWindow", step: int, epoch: int):
         self._window = window
         self.step = step
         self.epoch = epoch
+        # the ambient request trace at DISPATCH time (None untraced) —
+        # stamped now so a deferred drain error still names its origin
+        self.trace_id = trace_context.current_trace_id()
         self._value: Optional[float] = None
         self._error: Optional[AsyncStepError] = None
 
@@ -206,7 +217,8 @@ class AsyncScoreWindow:
                 with mon.phase("drain"):
                     value = _fetch_scalar(loss)
         except Exception as e:  # surfaced with the step it belongs to
-            handle._error = AsyncStepError(handle.step, handle.epoch, e)
+            handle._error = AsyncStepError(handle.step, handle.epoch, e,
+                                           trace_id=handle.trace_id)
             raise handle._error
         handle._value = value
         self.model._score_value = value

@@ -51,6 +51,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flight
 from deeplearning4j_tpu.parallel.mesh import DeviceMesh
 
 
@@ -81,7 +82,10 @@ class ParallelInference:
     on_shed: optional callback(n, klass) invoked when n deadline-expired
     requests of priority class ``klass`` are shed at dispatch;
     on_depth: optional callback(backlog) invoked whenever requests leave
-    the lanes (dispatch or shed) — the queue-depth gauge feed.
+    the lanes (dispatch or shed) — the queue-depth gauge feed;
+    name: worker-thread name prefix (threads are ``<name>-<idx>``) — the
+    gateway registry passes ``pi-<model>`` so stack dumps and Perfetto
+    thread tracks identify which model a worker serves.
     """
 
     def __init__(self, model, mesh: Optional[DeviceMesh] = None,
@@ -89,9 +93,11 @@ class ParallelInference:
                  pad_batches: bool = True, max_queue: int = 0,
                  replicas: int = 1,
                  on_shed: Optional[Callable] = None,
-                 on_depth: Optional[Callable[[int], None]] = None):
+                 on_depth: Optional[Callable[[int], None]] = None,
+                 name: Optional[str] = None):
         self.model = model
         self.mesh = mesh
+        self.name = name or "pi-worker"
         self.batch_limit = batch_limit
         self.queue_timeout_s = queue_timeout_s
         # r5 (serving perf): a partially-filled batch is zero-padded up to
@@ -144,7 +150,8 @@ class ParallelInference:
         return self
 
     def _spawn(self, idx: int) -> None:
-        t = threading.Thread(target=self._run, args=(idx,), daemon=True)
+        t = threading.Thread(target=self._run, args=(idx,),
+                             name=f"{self.name}-{idx}", daemon=True)
         self._workers[idx] = t
         t.start()
 
@@ -199,13 +206,15 @@ class ParallelInference:
         return (self._q_lo if klass == "batch" else self._q).qsize()
 
     def submit(self, x, deadline: Optional[float] = None,
-               klass: Optional[str] = None) -> "queue.Queue":
+               klass: Optional[str] = None, trace=None) -> "queue.Queue":
         """Submit one example [features...] -> a result queue of size 1.
 
         ``deadline``: optional ``time.monotonic()`` instant; a request still
         undispatched past it is resolved with :class:`DeadlineExceeded`
         rather than executed. ``klass``: priority class — ``"batch"`` rides
-        the low-priority lane, anything else the primary lane. Raises
+        the low-priority lane, anything else the primary lane. ``trace``:
+        optional RequestTrace — the worker records the request's queue-wait
+        and device-dispatch spans on it (None = zero tracing work). Raises
         ``queue.Full`` when a bounded lane is at capacity and
         ``RuntimeError`` when the server is not accepting (stopped or
         draining). Worker threads found dead (they should be running while
@@ -221,7 +230,8 @@ class ParallelInference:
             self._revive("dead_thread")
         out: queue.Queue = queue.Queue(maxsize=1)
         lane = self._q_lo if klass == "batch" else self._q
-        lane.put_nowait((np.asarray(x), out, deadline, klass))
+        lane.put_nowait((np.asarray(x), out, deadline, klass, trace,
+                         time.monotonic() if trace is not None else 0.0))
         self._sem.release()
         return out
 
@@ -239,6 +249,13 @@ class ParallelInference:
         if mon is not None:
             mon.recovery_total.labels(component="serving",
                                       outcome=outcome).inc()
+        rec = flight.recorder()
+        if rec is not None:
+            # a dump-trigger kind: a worker death under load is exactly
+            # the incident the black box exists for
+            rec.record("worker_crash", severity="error", component="serving",
+                       worker=self.name, outcome=outcome,
+                       restarts=self.restarts)
 
     def _revive(self, outcome: str):
         """Restart dead worker threads (detected at submit time). Queued
@@ -321,8 +338,13 @@ class ParallelInference:
                         "deadline passed before dispatch"))
                     pending.remove(item)
                     shed[item[3]] = shed.get(item[3], 0) + 1
+                    if item[4] is not None:
+                        item[4].add_span("queue_wait", item[5], now)
+                        item[4].event("shed", reason="deadline")
                 else:
                     live.append(item)
+                    if item[4] is not None:
+                        item[4].add_span("queue_wait", item[5], now)
             if shed and self.on_shed is not None:
                 for klass, n in shed.items():
                     self.on_shed(n, klass)
@@ -340,6 +362,7 @@ class ParallelInference:
                 if bucket > n:
                     pad = np.zeros((bucket - n,) + xs.shape[1:], xs.dtype)
                     xs = np.concatenate([xs, pad])
+            t_dis = time.monotonic()
             try:
                 ys = np.asarray(self.output(xs))[:n]
             except Exception as e:  # noqa: BLE001 — an EXPECTED failure
@@ -349,7 +372,11 @@ class ParallelInference:
                     item[1].put(e)
                     pending.remove(item)
                 return
+            t_done = time.monotonic()
             for item, y in zip(live, ys):
+                if item[4] is not None:
+                    item[4].add_span("device_dispatch", t_dis, t_done,
+                                     batch=len(live))
                 item[1].put(y)
                 pending.remove(item)
         except Exception as e:  # noqa: BLE001 — crash path: resolve every

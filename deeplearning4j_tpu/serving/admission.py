@@ -31,6 +31,7 @@ from typing import List, Optional
 import numpy as np
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flight
 from deeplearning4j_tpu.parallel.inference import DeadlineExceeded
 from deeplearning4j_tpu.serving.http import HttpError
 from deeplearning4j_tpu.serving.registry import ModelVersion
@@ -67,11 +68,20 @@ class AdmissionController:
         return min(max(float(ms) / 1000.0, 0.001), self.max_timeout_s)
 
     def _shed(self, model: str, reason: str, n: int = 1,
-              klass: Optional[str] = None):
+              klass: Optional[str] = None, trace=None):
         mon = monitoring.serving_monitor()
         if mon is not None:
             mon.shed_total.labels(model=model, reason=reason,
                                   **{"class": klass or "default"}).inc(n)
+        rec = flight.recorder()
+        if rec is not None:
+            # SLO-driven sheds are a trigger kind: the recorder dumps a
+            # postmortem bundle carrying this request's trace
+            rec.record("slo_shed" if reason == "slo" else "shed",
+                       severity="warn", model=model, reason=reason,
+                       klass=klass or "default", n=n, trace=trace)
+        if trace is not None:
+            trace.event("shed", reason=reason, model=model)
 
     # ---------------------------------------------------------- backoff hint
     def observe_service(self, seconds_per_request: float) -> None:
@@ -99,19 +109,20 @@ class AdmissionController:
 
     # -------------------------------------------------------------- submit
     def submit(self, mv: ModelVersion, xs: np.ndarray, deadline: float,
-               klass: Optional[str] = None) -> List["queue.Queue"]:
+               klass: Optional[str] = None, trace=None) -> List["queue.Queue"]:
         """Admit every row of ``xs`` to ``mv``'s worker, or reject with a
         429 (queue full) / 503 (worker draining). Capacity for the WHOLE
         request is checked up front so a rejected multi-row request does
         not half-admit; rows that slip through the precheck race keep
         their deadline, so the worker eventually sheds them rather than
         holding them forever. ``klass`` routes ``batch`` to the worker's
-        low-priority lane."""
+        low-priority lane; ``trace`` rides into the lane so the worker
+        records this request's queue-wait and dispatch spans."""
         cap = mv.pi.max_queue
         if cap and mv.pi.lane_backlog(klass) + len(xs) > cap:
             # per-LANE capacity: a saturated batch lane must not starve
             # interactive admission
-            self._shed(mv.name, "queue_full", klass=klass)
+            self._shed(mv.name, "queue_full", klass=klass, trace=trace)
             raise HttpError(
                 429, f"model {mv.name!r} queue is full ({cap} pending); "
                 "retry later",
@@ -119,16 +130,17 @@ class AdmissionController:
         queues = []
         for x in xs:
             try:
-                queues.append(mv.pi.submit(x, deadline=deadline, klass=klass))
+                queues.append(mv.pi.submit(x, deadline=deadline, klass=klass,
+                                           trace=trace))
             except queue.Full:
-                self._shed(mv.name, "queue_full", klass=klass)
+                self._shed(mv.name, "queue_full", klass=klass, trace=trace)
                 raise HttpError(
                     429, f"model {mv.name!r} queue is full "
                     f"({mv.pi.max_queue} pending); retry later",
                     headers=self._retry_headers(mv.pi.backlog())) from None
             except RuntimeError:
                 # worker draining (hot reload / shutdown race)
-                self._shed(mv.name, "draining", klass=klass)
+                self._shed(mv.name, "draining", klass=klass, trace=trace)
                 raise HttpError(
                     503, f"model {mv.name!r} version {mv.version!r} is "
                     "draining; retry", headers=self._retry_headers()) from None
@@ -140,7 +152,7 @@ class AdmissionController:
 
     # -------------------------------------------------------------- gather
     def gather(self, mv: ModelVersion, queues: List["queue.Queue"],
-               deadline: float, klass: Optional[str] = None
+               deadline: float, klass: Optional[str] = None, trace=None
                ) -> List[np.ndarray]:
         """Collect every result before the deadline; a timeout or a
         deadline-shed result is a 504 (the remaining siblings carry the
@@ -153,7 +165,7 @@ class AdmissionController:
             try:
                 r = q.get(timeout=max(remaining, 0.001))
             except queue.Empty:
-                self._shed(mv.name, "deadline", klass=klass)
+                self._shed(mv.name, "deadline", klass=klass, trace=trace)
                 raise HttpError(
                     504, f"model {mv.name!r} deadline exceeded "
                     "waiting for result") from None

@@ -28,6 +28,7 @@ import threading
 from typing import Dict, Optional
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flight
 
 
 class ReplicaAutoscaler:
@@ -84,6 +85,13 @@ class ReplicaAutoscaler:
                 direction, streak = "down", 0
             self._streaks[key] = streak
             target = mv.pi._target
+            if direction is not None:
+                rec = flight.recorder()
+                if rec is not None:
+                    rec.record("autoscale", model=mv.name,
+                               version=mv.version, direction=direction,
+                               replicas=target,
+                               backlog_per_replica=round(per_replica, 3))
             if mon is not None:
                 mon.replicas.labels(model=mv.name,
                                     version=mv.version).set(target)

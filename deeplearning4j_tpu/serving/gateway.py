@@ -22,7 +22,16 @@ One HTTP server multiplexing many named, versioned models:
     GET  /slo                 per-class SLO status: objective, burn rate,
                               and whether the class is currently shedding
                               ({"enabled": false} without SLO config)
-    GET  /metrics             Prometheus exposition (process-wide registry)
+    GET  /metrics             Prometheus exposition (process-wide registry;
+                              ``?exemplars=1`` upgrades to OpenMetrics with
+                              trace-id exemplars on latency buckets)
+    GET  /debug/requests      request-tracer table: in-flight + recently
+                              completed traces with per-stage timing
+                              ({"enabled": false} without ``trace=``)
+    GET  /debug/trace/<id>    ONE request as Chrome trace-event JSON
+                              (load in Perfetto / chrome://tracing)
+    GET  /debug/flight        flight-recorder tail: recent structured
+                              incidents and where bundles were dumped
 
 Admission outcomes a client sees: 200 (served), 429 + ``Retry-After``
 (queue full, over quota, or shed for a burning higher class — back off),
@@ -53,6 +62,7 @@ flush every model's worker queue, then join. Nothing admitted is dropped.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Optional, Sequence
@@ -60,10 +70,12 @@ from typing import Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.common.env import Environment, _flag
+from deeplearning4j_tpu.monitoring import context, flight
 from deeplearning4j_tpu.serving.admission import AdmissionController
 from deeplearning4j_tpu.serving.generate import handle_generate, match_generate
-from deeplearning4j_tpu.serving.http import (HttpError, _HttpServerMixin,
-                                             serve_json)
+from deeplearning4j_tpu.serving.http import (HttpError, StreamingResponse,
+                                             _HttpServerMixin, serve_json)
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 
 
@@ -73,6 +85,23 @@ def _match_predict(path: str):
     if len(parts) == 3 and parts[0] == "v1" and parts[2] == "predict":
         return {"name": parts[1]}
     return None
+
+
+def _match_debug_trace(path: str):
+    """/debug/trace/<id> -> {"trace_id": id} (None = no match)."""
+    parts = path.strip("/").split("/")
+    if (len(parts) == 3 and parts[0] == "debug" and parts[1] == "trace"
+            and parts[2]):
+        return {"trace_id": parts[2]}
+    return None
+
+
+def _sp(trace, name: str, **args):
+    """``trace.span(name)`` or a no-op — the tracing None-gate inline, so
+    traced and untraced requests share one code path."""
+    if trace is None:
+        return contextlib.nullcontext()
+    return trace.span(name, **args)
 
 
 class ServingGateway(_HttpServerMixin):
@@ -95,7 +124,8 @@ class ServingGateway(_HttpServerMixin):
                  retry_after_s: float = 1.0,
                  seed: Optional[int] = None, admin: bool = True,
                  generate_max_queue: int = 64,
-                 tenants=None, slo=None, autoscale=None):
+                 tenants=None, slo=None, autoscale=None,
+                 trace: Optional[bool] = None):
         self._host, self._port = host, port
         self.admin = admin
         self.registry = ModelRegistry(
@@ -127,6 +157,13 @@ class ServingGateway(_HttpServerMixin):
                                if isinstance(autoscale, ReplicaAutoscaler)
                                else ReplicaAutoscaler(self.registry,
                                                       **autoscale))
+        # request tracing follows the same opt-in pattern: built only for
+        # trace=True (or DL4J_TPU_TRACING in the environment, read live so
+        # tests can monkeypatch it); otherwise ``tracer is None`` and the
+        # request path performs zero tracer calls
+        self.tracer = None
+        if trace or (trace is None and _flag(Environment.TRACING)):
+            self.tracer = monitoring.RequestTracer()
         self._generators: dict = {}
         self._draining = False
         self._inflight = 0
@@ -171,7 +208,8 @@ class ServingGateway(_HttpServerMixin):
             if self._inflight == 0:
                 self._idle.notify_all()
 
-    def _admit_tenant(self, name: str, body: dict, headers, cost: int):
+    def _admit_tenant(self, name: str, body: dict, headers, cost: int,
+                      trace=None):
         """The multi-tenant admission prelude shared by predict and
         generate: authorize the API key, shed if a higher-priority class
         is burning its SLO budget, then charge the quota. Returns the
@@ -182,7 +220,7 @@ class ServingGateway(_HttpServerMixin):
             tenant = self.tenancy.authorize(body, headers)
             klass = tenant.klass
         if self.slo is not None and self.slo.should_shed(klass):
-            self.admission._shed(name, "slo", klass=klass)
+            self.admission._shed(name, "slo", klass=klass, trace=trace)
             raise HttpError(
                 429, f"shedding {klass or 'default'} traffic: a higher-"
                 "priority class is over its latency objective",
@@ -191,18 +229,54 @@ class ServingGateway(_HttpServerMixin):
             try:
                 self.tenancy.admit(tenant, tokens=cost)
             except HttpError:
-                self.admission._shed(name, "quota", klass=klass)
+                self.admission._shed(name, "quota", klass=klass, trace=trace)
                 raise
         return klass
+
+    def _begin_trace(self, route: str, params, model: str):
+        """Mint a trace (tracer configured) and flight-record the admit
+        (recorder armed); both are None-gated no-ops otherwise."""
+        trace = None
+        if self.tracer is not None:
+            trace = self.tracer.begin(route, headers=params.get("_headers"),
+                                      model=model)
+        rec = flight.recorder()
+        if rec is not None:
+            rec.record("admit", route=route, model=model, trace=trace)
+        return trace
+
+    def _finish_trace(self, trace, exc: Optional[BaseException]) -> None:
+        """Close a trace with the request's disposition: backpressure codes
+        are ``shed`` (the reason says why), everything else that raised is
+        ``error``, a clean return is ``served``."""
+        if trace is None:
+            return
+        if exc is None:
+            self.tracer.finish(trace, "served", code=200)
+        elif isinstance(exc, HttpError):
+            disp = "shed" if exc.code in (429, 503, 504) else "error"
+            self.tracer.finish(trace, disp, code=exc.code,
+                               reason=exc.message)
+        else:
+            self.tracer.finish(trace, "error", code=400, reason=str(exc))
 
     def _predict(self, params, body):
         if self._draining:
             raise HttpError(503, "gateway is draining",
                             headers=self.admission._retry_headers())
         name = params["name"]
+        trace = self._begin_trace("/v1/*/predict", params, name)
         self._track(+1)
         try:
-            return self._predict_inner(name, body, params.get("_headers"))
+            with context.bind(trace):
+                payload = self._predict_inner(name, body,
+                                              params.get("_headers"),
+                                              trace=trace)
+            self._finish_trace(trace, None)
+            return payload
+        except BaseException as e:
+            self._finish_trace(trace, e)
+            raise
         finally:
             self._track(-1)
 
@@ -214,11 +288,26 @@ class ServingGateway(_HttpServerMixin):
         engine = self._generators.get(name)
         if engine is None:
             raise HttpError(404, f"generator {name!r} is not registered")
-        klass = self._admit_tenant(name, body, params.get("_headers"),
-                                   cost=int(body.get("max_new_tokens", 64)))
-        return handle_generate(self, engine, name, body, klass=klass)
+        trace = self._begin_trace("/v1/*/generate", params, name)
+        try:
+            with context.bind(trace):
+                with _sp(trace, "quota_check"):
+                    klass = self._admit_tenant(
+                        name, body, params.get("_headers"),
+                        cost=int(body.get("max_new_tokens", 64)),
+                        trace=trace)
+                payload = handle_generate(self, engine, name, body,
+                                          klass=klass, trace=trace)
+        except BaseException as e:
+            self._finish_trace(trace, e)
+            raise
+        if not isinstance(payload, StreamingResponse):
+            # streams finish their trace in on_finish, at last-token time
+            self._finish_trace(trace, None)
+        return payload
 
-    def _predict_inner(self, name: str, body: dict, headers=None):
+    def _predict_inner(self, name: str, body: dict, headers=None,
+                       trace=None):
         try:
             mv = self.registry.route(name)
         except KeyError:
@@ -226,26 +315,34 @@ class ServingGateway(_HttpServerMixin):
         xs = np.asarray(body["inputs"], np.float32)
         if xs.ndim < 1 or xs.shape[0] == 0:
             raise HttpError(400, "inputs must be a non-empty batch")
-        klass = self._admit_tenant(name, body, headers, cost=len(xs))
+        with _sp(trace, "quota_check"):
+            klass = self._admit_tenant(name, body, headers, cost=len(xs),
+                                       trace=trace)
         timeout = self.admission.timeout_for(body)
         deadline = time.monotonic() + timeout
         t0 = time.perf_counter()
         code = 200
         try:
-            try:
-                queues = self.admission.submit(mv, xs, deadline, klass=klass)
-            except HttpError as e:
-                if e.code != 503:
-                    raise
-                # the routed version started draining under us (hot reload /
-                # unload race): re-route once — the registry swap is atomic,
-                # so the retry sees the replacement. This is what makes hot
-                # reload zero-drop.
-                mv = self.registry.route(name)
-                queues = self.admission.submit(mv, xs, deadline, klass=klass)
-            outs = self.admission.gather(mv, queues, deadline, klass=klass)
-            return {"outputs": [y.tolist() for y in outs],
-                    "model": mv.name, "version": mv.version}
+            with _sp(trace, "submit", rows=len(xs)):
+                try:
+                    queues = self.admission.submit(mv, xs, deadline,
+                                                   klass=klass, trace=trace)
+                except HttpError as e:
+                    if e.code != 503:
+                        raise
+                    # the routed version started draining under us (hot
+                    # reload / unload race): re-route once — the registry
+                    # swap is atomic, so the retry sees the replacement.
+                    # This is what makes hot reload zero-drop.
+                    mv = self.registry.route(name)
+                    queues = self.admission.submit(mv, xs, deadline,
+                                                   klass=klass, trace=trace)
+            with _sp(trace, "gather"):
+                outs = self.admission.gather(mv, queues, deadline,
+                                             klass=klass, trace=trace)
+            with _sp(trace, "serialize"):
+                return {"outputs": [y.tolist() for y in outs],
+                        "model": mv.name, "version": mv.version}
         except HttpError as e:
             code = e.code
             raise
@@ -257,7 +354,10 @@ class ServingGateway(_HttpServerMixin):
             mon = monitoring.serving_monitor()
             if mon is not None:
                 mon.model_request_seconds.labels(
-                    model=name, version=mv.version, code=code).observe(elapsed)
+                    model=name, version=mv.version, code=code).observe(
+                    elapsed,
+                    exemplar=({"trace_id": trace.trace_id}
+                              if trace is not None else None))
             if self.slo is not None and code != 429:
                 # sheds don't spend latency budget; served outcomes —
                 # including 504s, which ARE objective misses — do
@@ -320,6 +420,32 @@ class ServingGateway(_HttpServerMixin):
             return {"enabled": False}
         return dict(self.slo.status(), enabled=True)
 
+    def _debug_requests(self, _body):
+        """In-flight + recently completed request traces (the tracer's
+        table), or ``{"enabled": false}`` on an untraced gateway."""
+        if self.tracer is None:
+            return {"enabled": False}
+        return dict(self.tracer.describe(), enabled=True)
+
+    def _debug_flight(self, _body):
+        """The flight recorder's recent-incident tail (process-wide), or
+        ``{"enabled": false}`` when no recorder is armed."""
+        rec = flight.recorder()
+        if rec is None:
+            return {"enabled": False}
+        return dict(rec.describe(), enabled=True)
+
+    def _debug_trace(self, params, _body):
+        """One request's Chrome trace-event JSON by trace id."""
+        if self.tracer is None:
+            raise HttpError(404, "tracing is not enabled on this gateway")
+        trace = self.tracer.get(params["trace_id"])
+        if trace is None:
+            raise HttpError(
+                404, f"unknown trace id {params['trace_id']!r} (in-flight "
+                "table and completed ring were checked)")
+        return trace.to_chrome()
+
     def _healthz(self, _body):
         """Liveness stays 200 (the process is up — restart-level health is
         the balancer's /readyz call), but the body surfaces self-healing
@@ -350,10 +476,15 @@ class ServingGateway(_HttpServerMixin):
                 "/readyz": self._readyz,
                 "/slo": self._slo_route,
                 "/models": lambda _: {"models": self.registry.describe()},
+                "/debug/requests": self._debug_requests,
+                "/debug/flight": self._debug_flight,
             },
             dynamic_post=[
                 ("/v1/*/predict", _match_predict, self._predict),
                 ("/v1/*/generate", match_generate, self._generate),
+            ],
+            dynamic_get=[
+                ("/debug/trace/*", _match_debug_trace, self._debug_trace),
             ])
         if self.autoscaler is not None:
             self.autoscaler.start()

@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Optional
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flight
 from deeplearning4j_tpu.serving.http import HttpError, StreamingResponse
 
 
@@ -54,7 +55,7 @@ def _prompt_from(body: dict, engine):
 
 
 def handle_generate(gateway, engine, name: str, body: dict,
-                    klass: Optional[str] = None):
+                    klass: Optional[str] = None, trace=None):
     """The /v1/<name>/generate handler body, shared by the gateway.
 
     Returns either a plain dict (one-shot) or a StreamingResponse whose
@@ -62,7 +63,10 @@ def handle_generate(gateway, engine, name: str, body: dict,
     ``ServingGateway.stop()`` drain streams, not just one-shot requests.
     ``klass`` is the caller's priority class (multi-tenant gateways):
     ``batch`` requests wait in the engine's low-priority pending lane, so
-    interactive submissions claim freed slots first.
+    interactive submissions claim freed slots first. ``trace`` (traced
+    gateways) rides into the engine stream for slot-lifetime spans; a
+    streaming response closes it in ``on_finish`` — at last-token (or
+    disconnect) time, not at headers-out time.
     """
     mon = monitoring.serving_monitor()
     gmon = monitoring.generate_monitor()
@@ -72,6 +76,13 @@ def handle_generate(gateway, engine, name: str, body: dict,
                                   **{"class": klass or "default"}).inc()
         if gmon is not None:
             gmon.requests_total.labels(outcome="shed").inc()
+        rec = flight.recorder()
+        if rec is not None:
+            rec.record("shed", severity="warn", model=name,
+                       reason="queue_full", klass=klass or "default",
+                       trace=trace)
+        if trace is not None:
+            trace.event("shed", reason="queue_full", model=name)
         raise HttpError(429, "generation queue is full",
                         headers=gateway.admission._retry_headers(
                             engine.pending_count()))
@@ -85,7 +96,7 @@ def handle_generate(gateway, engine, name: str, body: dict,
             top_p=float(body.get("top_p", 1.0)),
             seed=int(body.get("seed", 0)),
             eos_id=body.get("eos_id"),
-            klass=klass)
+            klass=klass, trace=trace)
     except RuntimeError as e:  # engine shut down
         raise HttpError(503, str(e),
                         headers=gateway.admission._retry_headers()) from None
@@ -109,6 +120,9 @@ def handle_generate(gateway, engine, name: str, body: dict,
     def finish():
         if not stream.done:
             stream.cancel()  # client went away: free the slot
+        if trace is not None:
+            gateway.tracer.finish(trace, "served", code=200,
+                                  reason=stream.finish_reason)
         gateway._track(-1)
 
     def lines():

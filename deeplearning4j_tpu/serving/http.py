@@ -22,8 +22,19 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flight
+
+
+def _record_gateway_error(route: str, exc: BaseException) -> None:
+    """Flight-record an UNHANDLED handler exception (HttpErrors are
+    intentional outcomes, not incidents) — a dump-trigger kind."""
+    rec = flight.recorder()
+    if rec is not None:
+        rec.record("gateway_error", severity="error", route=route,
+                   error=f"{type(exc).__name__}: {exc}")
 
 
 class HttpError(Exception):
@@ -163,6 +174,7 @@ def serve_json(host, port, post_routes, get_routes,
                     self._reply(e.code, {"error": e.message}, e.headers)
                     return
                 except Exception as e:  # noqa: BLE001 — serving boundary
+                    _record_gateway_error(label, e)
                     self._reply(400, {"error": str(e)})
                     return
                 if isinstance(payload, StreamingResponse):
@@ -178,6 +190,7 @@ def serve_json(host, port, post_routes, get_routes,
             except HttpError as e:
                 code, payload, headers = e.code, {"error": e.message}, e.headers
             except Exception as e:  # noqa: BLE001 — serving boundary
+                _record_gateway_error(label, e)
                 code, payload = 400, {"error": str(e)}
             finally:
                 mon.in_flight.dec()
@@ -202,11 +215,20 @@ def serve_json(host, port, post_routes, get_routes,
             self._route(post_routes, dynamic_post, body)
 
         def do_GET(self):  # noqa: N802
-            if self.path.split("?")[0] == "/metrics":
-                data = monitoring.metrics_text().encode()
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                # ?exemplars=1 upgrades the scrape to OpenMetrics with
+                # exemplars on histogram buckets (trace-id backlinks); the
+                # default scrape stays plain text format 0.0.4
+                want_ex = parse_qs(query).get("exemplars", ["0"])[0].lower() \
+                    not in ("", "0", "false", "off", "no")
+                data = monitoring.metrics_text(exemplars=want_ex).encode()
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header(
+                    "Content-Type",
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8" if want_ex
+                    else "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

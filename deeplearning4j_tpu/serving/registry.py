@@ -92,7 +92,8 @@ class ModelRegistry:
         pi = ParallelInference(
             model, batch_limit=limit, queue_timeout_s=self.queue_timeout_s,
             max_queue=self.max_queue if max_queue is None else max_queue,
-            on_shed=on_shed, on_depth=on_depth).start()
+            on_shed=on_shed, on_depth=on_depth,
+            name=f"pi-{name}-{version}").start()
         buckets = pow2_buckets(limit)
         timings: Dict[int, float] = {}
         if warmup and warmup_shape is not None:

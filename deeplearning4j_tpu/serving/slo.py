@@ -31,6 +31,7 @@ from collections import deque
 from typing import Dict, Optional
 
 from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu.monitoring import flight
 from deeplearning4j_tpu.serving.tenancy import PRIORITY_CLASSES, class_rank
 
 
@@ -66,6 +67,7 @@ class SloTracker:
         self.shed_threshold = float(shed_threshold)
         self._lock = threading.Lock()
         self._samples: Dict[str, deque] = {}     # klass -> deque[bool ok]
+        self._burning: set = set()   # classes past shed_threshold (edges)
         mon = monitoring.slo_monitor()
         if mon is not None:
             for klass, obj in self.objectives.items():
@@ -83,6 +85,24 @@ class SloTracker:
                                                deque(maxlen=self.window))
             samples.append(ok)
             burn = self._burn_locked(klass)
+            # edge-detect shed-threshold crossings for the flight recorder:
+            # one event per transition, not one per observation
+            crossed = None
+            if burn is not None:
+                if burn > self.shed_threshold and klass not in self._burning:
+                    self._burning.add(klass)
+                    crossed = "slo_burn"
+                elif burn <= self.shed_threshold and klass in self._burning:
+                    self._burning.discard(klass)
+                    crossed = "slo_recover"
+        if crossed is not None:
+            rec = flight.recorder()
+            if rec is not None:
+                rec.record(crossed,
+                           severity="warn" if crossed == "slo_burn"
+                           else "info",
+                           klass=klass, burn_rate=round(burn, 4),
+                           threshold=self.shed_threshold)
         mon = monitoring.slo_monitor()
         if mon is not None:
             mon.latency_seconds.labels(**{"class": klass}).observe(seconds)

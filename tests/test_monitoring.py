@@ -180,7 +180,8 @@ class TestSpanTracer:
         # timestamps are monotone within the thread
         ts = [e["ts"] for e in evs if e["ph"] in "BE"]
         assert ts == sorted(ts)
-        assert evs[1].get("args") == {"step": 1}
+        outer = next(e for e in evs if e["ph"] == "B" and e["name"] == "outer")
+        assert outer.get("args") == {"step": 1}
 
     def test_thread_aware_tids(self):
         tr = SpanTracer()

@@ -720,8 +720,12 @@ class TestMultiTenant:
         and monitoring off, a full HTTP predict makes ZERO metric writes and
         ZERO tenancy/slo calls (spy-guarded, same style as
         test_monitoring.py)."""
+        from deeplearning4j_tpu.monitoring.context import (RequestTrace,
+                                                           RequestTracer)
+        from deeplearning4j_tpu.monitoring.flight import FlightRecorder
         from deeplearning4j_tpu.monitoring.registry import (Counter, Gauge,
                                                             Histogram)
+        from deeplearning4j_tpu.monitoring.tracing import SpanTracer
         from deeplearning4j_tpu.serving import slo as slo_mod
         from deeplearning4j_tpu.serving import tenancy as tenancy_mod
         assert not monitoring.enabled()
@@ -745,12 +749,24 @@ class TestMultiTenant:
                             spy("SloTracker.observe"))
         monkeypatch.setattr(slo_mod.SloTracker, "should_shed",
                             spy("SloTracker.should_shed"))
+        # PR 12: the tracing/flight tier follows the same contract — an
+        # untraced gateway with no recorder armed performs zero trace or
+        # flight-recorder calls on the request path
+        monkeypatch.setattr(RequestTracer, "begin", spy("RequestTracer.begin"))
+        monkeypatch.setattr(RequestTrace, "add_span",
+                            spy("RequestTrace.add_span"))
+        monkeypatch.setattr(RequestTrace, "event", spy("RequestTrace.event"))
+        monkeypatch.setattr(FlightRecorder, "record",
+                            spy("FlightRecorder.record"))
+        monkeypatch.setattr(SpanTracer, "complete", spy("SpanTracer.complete"))
+        monkeypatch.setattr(SpanTracer, "instant", spy("SpanTracer.instant"))
         gw = ServingGateway(port=0, seed=0).start()
         base = f"http://127.0.0.1:{gw.port}"
         try:
             assert gw.tenancy is None
             assert gw.slo is None
             assert gw.autoscaler is None
+            assert gw.tracer is None
             gw.register_model("m", "v1", StubModel(), warmup=False)
             code, body, _ = _post(base, "/v1/m/predict",
                                   {"inputs": [[1.0, 2.0]]})
