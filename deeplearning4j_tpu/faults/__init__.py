@@ -30,6 +30,13 @@ Fault classes (the injection points that consume them in parentheses):
                          fires (bench.py chaos, tests) — the faults
                          grammar drives the OFFERED load, not just the
                          serving side
+    ``preempt``          in-process SIGTERM-equivalent at a chosen step
+                         (``@step==N``): with a LifecycleManager installed
+                         (serving.lifecycle) it runs the grace-budgeted
+                         preemption drain; unmanaged it raises
+                         :class:`PreemptionFault` so the driver dies
+                         mid-decode exactly like a real preemption
+                         (generation engine step loop, trainer fit loop)
 
 Spec grammar (``DL4J_TPU_FAULTS`` env var or :func:`configure`)::
 
@@ -68,7 +75,7 @@ from deeplearning4j_tpu.faults.retry import RetryPolicy  # noqa: F401 (re-export
 
 CLASSES = ("ckpt_io", "ckpt_corrupt", "coord_connect", "collective_delay",
            "worker_crash", "data_io", "infer_crash", "slow_worker",
-           "traffic_spike")
+           "traffic_spike", "preempt")
 
 ENV_SPEC = "DL4J_TPU_FAULTS"
 ENV_SEED = "DL4J_TPU_FAULTS_SEED"
@@ -95,6 +102,12 @@ class CoordinatorConnectFault(InjectedFault, ConnectionRefusedError):
 
 class InferenceWorkerCrash(InjectedFault, RuntimeError):
     """Injected inference-worker crash (``infer_crash``)."""
+
+
+class PreemptionFault(InjectedFault, RuntimeError):
+    """Injected preemption (``preempt``) with no lifecycle manager to
+    deliver it to — the raising driver is expected to die (or self-preempt)
+    exactly as a SIGTERM'd process would."""
 
 
 _OPS = {
@@ -293,6 +306,6 @@ configure(None)
 __all__ = [
     "CLASSES", "FaultPlan", "FaultRule", "RetryPolicy",
     "InjectedFault", "CheckpointIOFault", "DataReadFault",
-    "CoordinatorConnectFault", "InferenceWorkerCrash",
+    "CoordinatorConnectFault", "InferenceWorkerCrash", "PreemptionFault",
     "active", "configure", "injected", "parse_spec", "reset",
 ]

@@ -19,10 +19,12 @@ from deeplearning4j_tpu.generation.engine import (
     GenerationStream, RecurrentDecodeAdapter,
 )
 from deeplearning4j_tpu.generation.sampler import sample_keys, sample_logits
+from deeplearning4j_tpu.generation.sessions import SessionJournal, SessionRecord
 from deeplearning4j_tpu.generation.slots import SlotPool
 
 __all__ = [
     "AttentionDecodeAdapter", "CharCodec", "GenerationEngine",
     "GenerationRequest", "GenerationStream", "RecurrentDecodeAdapter",
-    "SlotPool", "sample_keys", "sample_logits",
+    "SessionJournal", "SessionRecord", "SlotPool",
+    "sample_keys", "sample_logits",
 ]

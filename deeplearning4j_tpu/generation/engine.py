@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu import faults, monitoring
 from deeplearning4j_tpu.generation.sampler import sample_keys, sample_logits
 from deeplearning4j_tpu.generation.slots import SlotPool
 from deeplearning4j_tpu.nn.layers.attention import (
@@ -81,10 +81,24 @@ _DONE = object()
 class GenerationStream:
     """Token stream for one request: iterate to receive tokens as the engine
     emits them; iteration ends when the request finishes or is cancelled.
-    ``finish_reason`` is one of eos / length / cancelled afterwards."""
+    ``finish_reason`` is one of eos / length / cancelled / preempted
+    afterwards.
 
-    def __init__(self, request: GenerationRequest):
+    Session-tracked streams (journal-armed engines) carry a ``request_id``
+    and a sequence offset ``seq0``: a stream resumed after a preemption
+    continues the ORIGINAL session's numbering, so a reconnecting client's
+    ``last_seq`` means the same thing across restarts. ``__iter__`` is the
+    single-consumer fast path (a SimpleQueue); :meth:`follow` is the
+    multi-consumer reconnect path.
+    """
+
+    def __init__(self, request: GenerationRequest,
+                 request_id: Optional[str] = None, seq0: int = 0):
         self.request = request
+        self.request_id = request_id
+        #: absolute sequence number already emitted BEFORE this stream
+        #: (non-zero only on session resume)
+        self.seq0 = int(seq0)
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.submitted_at = time.monotonic()
@@ -96,12 +110,16 @@ class GenerationStream:
         self.trace = None
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._cancelled = False
+        self._cancel_reason = "cancelled"
         self._last_at: Optional[float] = None
         self._done_evt = threading.Event()
+        self._cv = threading.Condition()
 
     # engine side -----------------------------------------------------
     def _emit(self, token: int) -> None:
-        self.tokens.append(token)
+        with self._cv:
+            self.tokens.append(token)
+            self._cv.notify_all()
         self._q.put(token)
 
     def _finish(self, reason: str) -> None:
@@ -109,10 +127,16 @@ class GenerationStream:
         self.finished_at = time.monotonic()
         self._q.put(_DONE)
         self._done_evt.set()
+        with self._cv:
+            self._cv.notify_all()
 
     # consumer side ---------------------------------------------------
-    def cancel(self) -> None:
-        """Ask the engine to retire this request at its next step."""
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Ask the engine to retire this request at its next step.
+        ``reason`` becomes the stream's ``finish_reason`` (the preemption
+        drain passes ``"preempted"``, which keeps the session journal
+        record open for resume)."""
+        self._cancel_reason = reason
         self._cancelled = True
 
     @property
@@ -129,6 +153,25 @@ class GenerationStream:
             if item is _DONE:
                 return
             yield item
+
+    def follow(self, last_seq: int = 0):
+        """Yield ``(seq, token)`` pairs with absolute sequence numbers
+        strictly greater than ``last_seq`` (1-based), then return when the
+        stream finishes. Unlike ``__iter__`` this does not consume the
+        queue, so any number of reconnecting consumers can follow one
+        stream concurrently and each sees every token exactly once."""
+        i = max(0, int(last_seq) - self.seq0)
+        while True:
+            with self._cv:
+                while len(self.tokens) <= i and not self.done:
+                    self._cv.wait(timeout=0.1)
+                avail = len(self.tokens)
+                done = self.done
+            while i < avail:
+                yield (self.seq0 + i + 1, self.tokens[i])
+                i += 1
+            if done and i >= len(self.tokens):
+                return
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the request finishes (without consuming the token
@@ -269,10 +312,17 @@ class AttentionDecodeAdapter:
 
     def prefill(self, params, net_state, prompt, length):
         """Causal forward over the padded prompt, harvesting each layer's
-        K/V into a fresh cache ring. ``length`` is unused: pad rows beyond
-        it land in ring positions the validity mask only admits AFTER the
-        sequential decode has overwritten them with real K/V."""
-        del length
+        K/V into a fresh cache ring.
+
+        When the bucketed prompt fits the ring (``Tb <= L``, the usual
+        engine configuration where ring == max_len), positions map to ring
+        slots 1:1 and ``length`` is unused: pad rows beyond it land in
+        ring positions the validity mask only admits AFTER the sequential
+        decode has overwritten them with real K/V. When the prompt is
+        LONGER than the ring (sliding-window adapters; session resume past
+        a ring wrap), the last ``L`` true positions are gathered into
+        their wrapped slots ``pos % L`` — exactly the ring a sequential
+        decode would have left behind."""
         net = self.net
         cp = _tree_cast(params, net._policy.compute_dtype)
         x = None
@@ -286,10 +336,26 @@ class AttentionDecodeAdapter:
                 x, _ = layer.apply(p, net_state[i], prompt, train=False)
             elif hasattr(layer, "apply_step"):
                 x, (k, v) = layer.apply_prefill(p, x)
-                ck, cv = layer.init_cache(prompt.shape[0], L, dtype=k.dtype)
                 Tb = prompt.shape[1]
-                ck = ck.at[:, :, :Tb].set(k)
-                cv = cv.at[:, :, :Tb].set(v)
+                if Tb <= L:
+                    ck, cv = layer.init_cache(prompt.shape[0], L,
+                                              dtype=k.dtype)
+                    ck = ck.at[:, :, :Tb].set(k)
+                    cv = cv.at[:, :, :Tb].set(v)
+                else:
+                    # ring slot r holds the one position p ≡ r (mod L)
+                    # inside the live window [length - L, length); slots
+                    # whose window position is negative (length < L) stay
+                    # zero and are either masked (index > pos) or
+                    # overwritten by the first decode step (index == pos)
+                    r = jnp.arange(L)
+                    start = length - L
+                    p_abs = start + jnp.mod(r - start, L)
+                    idx = jnp.clip(p_abs, 0, Tb - 1)
+                    keep = (p_abs >= 0)[None, None, :, None]
+                    zero = jnp.zeros((), k.dtype)
+                    ck = jnp.where(keep, k[:, :, idx], zero)
+                    cv = jnp.where(keep, v[:, :, idx], zero)
                 if self.kv_dtype == "int8":
                     # quantize the whole seeded ring in one pass; the
                     # running absmax scale then only grows during decode
@@ -334,12 +400,16 @@ class GenerationEngine:
 
     def __init__(self, net, *, slots: int = 8, max_len: int = 128,
                  eos_id: Optional[int] = None, continuous: bool = True,
-                 adapter=None, codec=None, kv_dtype: Optional[str] = None):
+                 adapter=None, codec=None, kv_dtype: Optional[str] = None,
+                 journal=None):
         self.net = net
         self.max_len = int(max_len)
         self.eos_id = eos_id
         self.continuous = continuous
         self.codec = codec
+        #: SessionJournal (generation/sessions.py) or None — with None the
+        #: engine performs ZERO journal calls (spy-guarded contract)
+        self.journal = journal
         if adapter is not None and kv_dtype is not None:
             raise ValueError("pass kv_dtype to the adapter OR let the "
                              "engine build one, not both")
@@ -359,7 +429,17 @@ class GenerationEngine:
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._accepting = True
+        # the stream currently inside _admit's prefill: not pending, not
+        # yet pooled — shutdown() cancels it here so a drain never waits
+        # for a decode step the grace budget can't afford
+        self._admitting: Optional[GenerationStream] = None
         self.steps_run = 0
+
+    def attach_journal(self, journal) -> None:
+        """Arm session journaling (see generation/sessions.py). Attach
+        BEFORE traffic: only requests submitted with a ``request_id``
+        after this point are durable."""
+        self.journal = journal
 
     # ---------------------------------------------------- compiled pieces
     def _decode_impl(self, params, net_state, pool_state, tokens, pos,
@@ -388,12 +468,16 @@ class GenerationEngine:
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                eos_id: Optional[int] = None,
                klass: Optional[str] = None,
-               trace=None) -> GenerationStream:
+               trace=None, request_id: Optional[str] = None
+               ) -> GenerationStream:
         """Queue a request; returns its token stream immediately.
         ``klass="batch"`` rides the low-priority pending lane — freed
         slots go to interactive/default requests first. ``trace`` (if any)
         is attached BEFORE the stream is enqueued, so the engine loop never
-        races a late trace assignment."""
+        races a late trace assignment. ``request_id`` (journal-armed
+        engines) makes the session durable: every emitted token is
+        journaled, and a known id is a resume whose sequence numbers
+        continue where the journal left off."""
         if isinstance(prompt, str):
             if self.codec is None:
                 raise ValueError("string prompt needs a codec")
@@ -417,11 +501,15 @@ class GenerationEngine:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), seed=int(seed),
             eos_id=self.eos_id if eos_id is None else eos_id)
-        stream = GenerationStream(req)
+        stream = GenerationStream(req, request_id=request_id)
         stream.trace = trace
         with self._cond:
             if not self._accepting:
                 raise RuntimeError("engine is shut down")
+            if self.journal is not None and request_id is not None:
+                # journal the admission before the stream is reachable by
+                # the engine loop — a token can never precede its open line
+                self.journal.attach(stream, klass=klass)
             if klass == "batch":
                 self._pending_lo.append(stream)
             else:
@@ -465,11 +553,20 @@ class GenerationEngine:
                 else:
                     return
             if stream.cancelled:
-                self._finish_stream(stream, "cancelled")
+                self._finish_stream(stream, stream._cancel_reason)
                 continue
             ids = stream.request.prompt
             t0 = time.monotonic()
-            sub = self._prefill_state(ids)
+            self._admitting = stream
+            try:
+                sub = self._prefill_state(ids)
+            finally:
+                self._admitting = None
+            if stream.cancelled:
+                # a shutdown/cancel landed DURING the prefill: retire now,
+                # never paying the decode step the old code waited for
+                self._finish_stream(stream, stream._cancel_reason)
+                continue
             slot = free.pop(0)
             req = stream.request
             self.pool.admit(
@@ -489,6 +586,8 @@ class GenerationEngine:
                 stream.trace.event("admit", slot=slot)
 
     def _finish_stream(self, stream: GenerationStream, reason: str) -> None:
+        if self.journal is not None and stream.request_id is not None:
+            self.journal.finished(stream, reason)
         stream._finish(reason)
         if stream.trace is not None:
             if stream.first_token_at is not None:
@@ -509,7 +608,23 @@ class GenerationEngine:
     def step(self) -> bool:
         """Admit + one decode step for the whole pool. Returns False when
         there was nothing to do. Single-driver only."""
+        plan = faults.active()
+        if plan is not None and plan.fires("preempt", step=self.steps_run):
+            # the in-process SIGTERM-equivalent: hand off to the lifecycle
+            # manager (which drains + journals from its own thread), or —
+            # unmanaged — raise so the driver/loop performs a hard
+            # self-preemption. Lazy import keeps `import ...generation`
+            # free of the serving stack (import-graph guard).
+            from deeplearning4j_tpu.serving import lifecycle
+            lifecycle.deliver_preemption(source="generation",
+                                         step=self.steps_run)
         self._admit()
+        # sweep cancellations BEFORE the decode: a cancel that landed after
+        # admission must not pay (or hold a slot through) a full step
+        for s in self.pool.active_slots():
+            st: GenerationStream = self.pool.meta[s]
+            if st.cancelled:
+                self._retire(s, st._cancel_reason)
         act = self.pool.active_slots()
         mon = monitoring.generate_monitor()
         if not act:
@@ -526,7 +641,7 @@ class GenerationEngine:
         for s in act:
             stream: GenerationStream = pool.meta[s]
             if stream.cancelled:
-                self._retire(s, "cancelled")
+                self._retire(s, stream._cancel_reason)
                 continue
             tok = int(nxt[s])
             pool.pos[s] += 1
@@ -536,6 +651,8 @@ class GenerationEngine:
                 self._retire(s, "eos")
                 continue
             stream._emit(tok)
+            if self.journal is not None and stream.request_id is not None:
+                self.journal.emitted(stream, tok)
             if mon is not None:
                 if stream.first_token_at is None:
                     mon.ttft_seconds.observe(
@@ -590,11 +707,42 @@ class GenerationEngine:
                     self._cond.wait(timeout=0.05)
                 if not self._running and not self.has_work():
                     return
-            self.step()
+            try:
+                self.step()
+            except faults.PreemptionFault:
+                # an injected preemption with no lifecycle manager: behave
+                # like the process died mid-decode — retire everything as
+                # "preempted" (journal records stay open for resume) and
+                # stop the loop, leaving the engine shut down
+                self._self_preempt()
+                return
 
-    def shutdown(self, timeout: float = 10.0) -> None:
+    def _self_preempt(self) -> None:
+        """Hard in-loop preemption: runs ON the loop thread, so it must not
+        join it — everything in flight finishes as ``preempted``."""
+        with self._cond:
+            self._accepting = False
+            self._running = False
+            pending = list(self._pending) + list(self._pending_lo)
+            self._pending.clear()
+            self._pending_lo.clear()
+            self._cond.notify_all()
+        for stream in pending:
+            self._finish_stream(stream, "preempted")
+        for s in self.pool.active_slots():
+            self._retire(s, "preempted")
+        self._thread = None
+
+    def shutdown(self, timeout: float = 10.0,
+                 reason: str = "cancelled") -> None:
         """Stop accepting, let in-flight streams finish up to ``timeout``
-        seconds, then cancel whatever remains and stop the loop."""
+        seconds, then cancel whatever remains and stop the loop.
+
+        ``reason="preempted"`` is the grace-budgeted preemption drain: the
+        stragglers' terminal lines say ``preempted`` and — on journal-armed
+        engines — their session records stay OPEN on disk, so a restarted
+        engine resumes them (serving/lifecycle.py drives this path).
+        """
         deadline = time.monotonic() + timeout
         with self._cond:
             self._accepting = False
@@ -605,15 +753,19 @@ class GenerationEngine:
         else:
             while time.monotonic() < deadline and self.has_work():
                 self.step()
-        # past the deadline: cancel stragglers (both priority lanes)
+        # past the deadline: cancel stragglers (both priority lanes, plus
+        # any stream caught mid-prefill — see _admit's post-prefill check)
         with self._cond:
             pending = list(self._pending) + list(self._pending_lo)
             self._pending = collections.deque()
             self._pending_lo = collections.deque()
+        admitting = self._admitting
+        if admitting is not None:
+            admitting.cancel(reason)
         for stream in pending:
-            self._finish_stream(stream, "cancelled")
+            self._finish_stream(stream, reason)
         for s in self.pool.active_slots():
-            self.pool.meta[s].cancel()
+            self.pool.meta[s].cancel(reason)
         if self._thread is not None:
             with self._cond:
                 self._running = False
@@ -621,4 +773,4 @@ class GenerationEngine:
             self._thread.join(timeout=5.0)
             self._thread = None
         for s in self.pool.active_slots():
-            self._retire(s, "cancelled")
+            self._retire(s, reason)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import warnings
 from typing import Callable, Optional
 
@@ -119,6 +120,11 @@ class FaultTolerantTrainer:
         self._check_crash_loop(max_restarts_without_progress)
         if self.restored_step is not None and on_restore:
             on_restore(self.restored_step)
+        # set whenever no fit() loop is mid-step: the preemption drain's
+        # emergency save waits on it so it never serializes arrays a
+        # concurrent (donating) train step is about to delete
+        self._parked = threading.Event()
+        self._parked.set()
 
     # --------------------------------------------------- crash-loop bound
     def _crashloop_path(self) -> str:
@@ -163,7 +169,54 @@ class FaultTolerantTrainer:
                 f"deterministic — inspect the step, the data at it, and "
                 f"{path} before relaunching (delete the file to override).")
 
+    # ------------------------------------------------------- preemption
+    def register_lifecycle(self, manager) -> "FaultTolerantTrainer":
+        """Register the emergency checkpoint with a
+        :class:`~deeplearning4j_tpu.serving.lifecycle.LifecycleManager`:
+        on SIGTERM (or an injected ``preempt`` fault) the drain saves the
+        current step inside the grace budget, so the relaunch loses zero
+        steps instead of up to ``save_every``."""
+        manager.register_checkpoint(self._emergency_save)
+        return self
+
+    def _emergency_save(self) -> None:
+        self._parked.wait(timeout=30.0)
+        self.checkpointer.save(self._target.step_count, self._target)
+        self.checkpointer.wait()
+        from deeplearning4j_tpu import monitoring
+
+        mon = monitoring.recovery_monitor()
+        if mon is not None:
+            mon.recovery_total.labels(component="trainer",
+                                      outcome="preempt_save").inc()
+
+    @staticmethod
+    def _preempting() -> bool:
+        """A managed preemption drain is in progress (the fit loop exits
+        between batches so the emergency save captures settled state)."""
+        from deeplearning4j_tpu.serving import lifecycle
+
+        mgr = lifecycle.manager()
+        return mgr is not None and mgr.reason is not None
+
     def fit_batch(self, ds) -> float:
+        from deeplearning4j_tpu import faults
+
+        plan = faults.active()
+        if plan is not None and plan.fires("preempt",
+                                           step=self._target.step_count):
+            # in-process SIGTERM equivalent: managed -> the lifecycle
+            # drain starts (this call returns and the fit loop exits at
+            # the next batch boundary); unmanaged -> PreemptionFault
+            # propagates into fit()'s save-on-exception path
+            from deeplearning4j_tpu.serving import lifecycle
+
+            lifecycle.deliver_preemption(source="trainer",
+                                         step=self._target.step_count)
+            if self._preempting():
+                # managed: the grace budget pays for the checkpoint, not
+                # another train step — the drain saves the current one
+                return float("nan")
         loss = self.model.fit_batch(ds)
         step = self._target.step_count
         if step % self.save_every == 0:
@@ -171,10 +224,15 @@ class FaultTolerantTrainer:
         return loss
 
     def fit(self, data, epochs: int = 1):
+        self._parked.clear()
         try:
             for _ in range(epochs):
                 for ds in data:
                     self.fit_batch(ds)
+                    if self._preempting():
+                        # the drain's checkpoint callback (see
+                        # register_lifecycle) saves this step
+                        return self.model
                 if hasattr(data, "reset"):
                     data.reset()
                 self._target.epoch_count += 1
@@ -195,6 +253,8 @@ class FaultTolerantTrainer:
                 # original failure with a checkpoint error
                 warnings.warn(f"save-on-exception failed: {save_err}")
             raise
+        finally:
+            self._parked.set()
         self.checkpointer.save(self._target.step_count, self._target)
         self.checkpointer.wait()
         return self.model

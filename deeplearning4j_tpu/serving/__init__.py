@@ -19,10 +19,15 @@ Grew out of the single-model ``serving.py`` (kept importable here unchanged:
 - :mod:`~deeplearning4j_tpu.serving.slo` — per-class latency objectives,
   burn rate, shed-lowest-class-first overload policy, ``GET /slo``;
 - :mod:`~deeplearning4j_tpu.serving.autoscale` — backlog-driven replica
-  autoscaling of each model's ParallelInference worker pool.
+  autoscaling of each model's ParallelInference worker pool;
+- :mod:`~deeplearning4j_tpu.serving.lifecycle` — preemption-aware drain:
+  SIGTERM -> journal sessions -> emergency checkpoint -> exit 0;
+- :mod:`~deeplearning4j_tpu.serving.failover` — per-replica circuit
+  breakers + idempotency-keyed cross-replica retry of failed predicts.
 
 See ``docs/serving.md`` for routes, admission knobs, and a canary example;
-``docs/slo.md`` for the multi-tenant/SLO runbook.
+``docs/slo.md`` for the multi-tenant/SLO runbook; ``docs/fault_tolerance.md``
+for preemption + session recovery.
 """
 
 # Lazy re-exports (PEP 562): the generation engine imports
@@ -50,6 +55,11 @@ _EXPORTS = {
     "bucket_for": "deeplearning4j_tpu.serving.warmup",
     "pow2_buckets": "deeplearning4j_tpu.serving.warmup",
     "warmup_model": "deeplearning4j_tpu.serving.warmup",
+    "LifecycleManager": "deeplearning4j_tpu.serving.lifecycle",
+    "CircuitBreaker": "deeplearning4j_tpu.serving.failover",
+    "GatewayFailover": "deeplearning4j_tpu.serving.failover",
+    "IdempotencyCache": "deeplearning4j_tpu.serving.failover",
+    "ReplicaFailed": "deeplearning4j_tpu.serving.failover",
 }
 
 __all__ = [
@@ -59,6 +69,8 @@ __all__ = [
     "SloTracker", "ReplicaAutoscaler",
     "ModelServer", "KNNServer",
     "pow2_buckets", "bucket_for", "warmup_model",
+    "LifecycleManager", "CircuitBreaker", "GatewayFailover",
+    "IdempotencyCache", "ReplicaFailed",
 ]
 
 

@@ -125,7 +125,7 @@ class ServingGateway(_HttpServerMixin):
                  seed: Optional[int] = None, admin: bool = True,
                  generate_max_queue: int = 64,
                  tenants=None, slo=None, autoscale=None,
-                 trace: Optional[bool] = None):
+                 trace: Optional[bool] = None, failover=None):
         self._host, self._port = host, port
         self.admin = admin
         self.registry = ModelRegistry(
@@ -164,7 +164,21 @@ class ServingGateway(_HttpServerMixin):
         self.tracer = None
         if trace or (trace is None and _flag(Environment.TRACING)):
             self.tracer = monitoring.RequestTracer()
+        # failover tier (opt-in, same contract): per-replica circuit
+        # breakers + idempotency-keyed cross-replica retry of non-streaming
+        # predicts. None = the predict path does zero breaker/cache work.
+        self.failover = None
+        if failover is not None:
+            from deeplearning4j_tpu.serving.failover import GatewayFailover
+
+            self.failover = (failover
+                             if isinstance(failover, GatewayFailover)
+                             else GatewayFailover(**failover))
         self._generators: dict = {}
+        # per-generator session journals (crash-recoverable generation);
+        # empty dict on an unconfigured gateway — the generate path checks
+        # truthiness once and performs zero journal calls
+        self._sessions: dict = {}
         self._draining = False
         self._inflight = 0
         self._inflight_lock = threading.Lock()
@@ -189,11 +203,30 @@ class ServingGateway(_HttpServerMixin):
     def set_split(self, name: str, weights):
         return self.registry.set_split(name, weights)
 
-    def register_generator(self, name: str, engine):
+    def register_generator(self, name: str, engine, *, sessions=None,
+                           resume: bool = True):
         """Attach a started :class:`GenerationEngine` under
         ``POST /v1/<name>/generate`` (streaming). The engine's background
-        step loop is started here if it isn't running yet."""
+        step loop is started here if it isn't running yet.
+
+        ``sessions`` (a journal path or a
+        :class:`~deeplearning4j_tpu.generation.sessions.SessionJournal`)
+        arms crash-recoverable sessions: requests carrying an
+        ``X-Request-Id`` become durable, clients reconnect with
+        ``last_seq``, and — with ``resume=True`` — sessions interrupted by
+        a previous process's preemption are re-submitted into this engine
+        BEFORE it takes new traffic (register, then ``start()`` the
+        gateway)."""
+        if sessions is not None:
+            from deeplearning4j_tpu.generation.sessions import SessionJournal
+
+            journal = (sessions if isinstance(sessions, SessionJournal)
+                       else SessionJournal(sessions))
+            engine.attach_journal(journal)
+            self._sessions[name] = journal
         self._generators[name] = engine.start()
+        if sessions is not None and resume:
+            self._sessions[name].resume_into(engine)
         return engine
 
     def unregister_generator(self, name: str, *, timeout: float = 10.0):
@@ -297,7 +330,8 @@ class ServingGateway(_HttpServerMixin):
                         cost=int(body.get("max_new_tokens", 64)),
                         trace=trace)
                 payload = handle_generate(self, engine, name, body,
-                                          klass=klass, trace=trace)
+                                          klass=klass, trace=trace,
+                                          headers=params.get("_headers"))
         except BaseException as e:
             self._finish_trace(trace, e)
             raise
@@ -308,8 +342,44 @@ class ServingGateway(_HttpServerMixin):
 
     def _predict_inner(self, name: str, body: dict, headers=None,
                        trace=None):
+        fo = self.failover
+        if fo is None:
+            return self._predict_attempt(name, body, headers, trace)
+        from deeplearning4j_tpu.serving.failover import ReplicaFailed
+
+        idem = fo.idempotency_key(body, headers)
+        if idem is not None:
+            cached = fo.idempotency.get(idem)
+            if cached is not None:
+                # exactly-once from the client's view: replay the stored
+                # response instead of re-running the forward
+                if trace is not None:
+                    trace.event("idempotent_replay")
+                return cached
+        failed: set = set()
+
+        def attempt():
+            payload = self._predict_attempt(
+                name, body, headers, trace,
+                exclude=fo.excluded(name) | failed, failover=fo,
+                failed=failed)
+            if idem is not None:
+                fo.idempotency.put(idem, payload)
+            return payload
+
         try:
-            mv = self.registry.route(name)
+            # the shared RetryPolicy owns backoff + attempt accounting:
+            # dl4j_retry_attempts_total{component="gateway"} and
+            # dl4j_recovery_total{component="gateway",outcome="retried_ok"}
+            return fo.retry_policy.call(attempt, component="gateway")
+        except ReplicaFailed as e:
+            raise e.error
+
+    def _predict_attempt(self, name: str, body: dict, headers=None,
+                         trace=None, exclude=(), failover=None,
+                         failed=None):
+        try:
+            mv = self.registry.route(name, exclude=exclude)
         except KeyError:
             raise HttpError(404, f"model {name!r} is not registered") from None
         xs = np.asarray(body["inputs"], np.float32)
@@ -334,17 +404,36 @@ class ServingGateway(_HttpServerMixin):
                     # reload / unload race): re-route once — the registry
                     # swap is atomic, so the retry sees the replacement.
                     # This is what makes hot reload zero-drop.
-                    mv = self.registry.route(name)
+                    mv = self.registry.route(name, exclude=exclude)
                     queues = self.admission.submit(mv, xs, deadline,
                                                    klass=klass, trace=trace)
             with _sp(trace, "gather"):
                 outs = self.admission.gather(mv, queues, deadline,
                                              klass=klass, trace=trace)
+            if failover is not None:
+                failover.record(name, mv.version, ok=True, trace=trace)
             with _sp(trace, "serialize"):
                 return {"outputs": [y.tolist() for y in outs],
                         "model": mv.name, "version": mv.version}
         except HttpError as e:
             code = e.code
+            if e.code == 500 and failover is not None:
+                # the replica's forward failed: feed its breaker, and if a
+                # healthy sibling exists hand the request to it via the
+                # retry policy (ReplicaFailed is the retryable wrapper)
+                failover.record(name, mv.version, ok=False, trace=trace)
+                if failed is not None:
+                    failed.add(mv.version)
+                siblings = [v for v in self.registry.versions(name)
+                            if failed is None or v not in failed]
+                if siblings:
+                    from deeplearning4j_tpu.serving.failover import (
+                        ReplicaFailed)
+
+                    if trace is not None:
+                        trace.event("failover", model=name,
+                                    version=mv.version)
+                    raise ReplicaFailed(e) from e
             raise
         except Exception:
             code = 400
@@ -420,6 +509,13 @@ class ServingGateway(_HttpServerMixin):
             return {"enabled": False}
         return dict(self.slo.status(), enabled=True)
 
+    def _failover_route(self, _body):
+        """Per-replica breaker states + idempotency stats, or
+        ``{"enabled": false}`` on a gateway without failover config."""
+        if self.failover is None:
+            return {"enabled": False}
+        return dict(self.failover.describe(), enabled=True)
+
     def _debug_requests(self, _body):
         """In-flight + recently completed request traces (the tracer's
         table), or ``{"enabled": false}`` on an untraced gateway."""
@@ -475,6 +571,7 @@ class ServingGateway(_HttpServerMixin):
                 "/healthz": self._healthz,
                 "/readyz": self._readyz,
                 "/slo": self._slo_route,
+                "/failover": self._failover_route,
                 "/models": lambda _: {"models": self.registry.describe()},
                 "/debug/requests": self._debug_requests,
                 "/debug/flight": self._debug_flight,

@@ -188,8 +188,11 @@ class ModelRegistry:
             self._splits[name] = {v: float(w) for v, w in weights.items()}
             return dict(self._splits[name])
 
-    def route(self, name: str) -> ModelVersion:
-        """Pick a version by weighted random choice over the name's split."""
+    def route(self, name: str, exclude=()) -> ModelVersion:
+        """Pick a version by weighted random choice over the name's split.
+        ``exclude`` (circuit-broken replicas, already-failed attempts)
+        filters the candidates; when it would empty the set it is ignored
+        — routing somewhere honest beats fabricating a 404."""
         with self._lock:
             versions = self._models.get(name)
             if not versions:
@@ -199,6 +202,11 @@ class ModelRegistry:
                         if w > 0 and v in versions]
             if not weighted:
                 weighted = [(mv, 1.0) for mv in versions.values()]
+            if exclude:
+                kept = [(mv, w) for mv, w in weighted
+                        if mv.version not in exclude]
+                if kept:
+                    weighted = kept
             total = sum(w for _, w in weighted)
             r = self._rng.random() * total
             for mv, w in weighted:
@@ -210,6 +218,11 @@ class ModelRegistry:
     def get(self, name: str, version: str) -> Optional[ModelVersion]:
         with self._lock:
             return self._models.get(name, {}).get(version)
+
+    def versions(self, name: str) -> List[str]:
+        """Registered version ids for a name (empty when unknown)."""
+        with self._lock:
+            return sorted(self._models.get(name, {}))
 
     # -------------------------------------------------------------- status
     def names(self) -> List[str]:
