@@ -2519,6 +2519,157 @@ def bench_faults(steps=150, rounds=3):
     }
 
 
+def bench_guardrails(steps=120, rounds=3):
+    """Training-guardrails lane: what the numeric sentinel costs and what
+    a trip costs to recover from.
+
+    Lanes, one small MLN fit loop each (the sentinel is in-step device
+    work plus host screening, so the small-model fit loop is the
+    worst case for relative overhead):
+      - ``off`` vs ``armed``: fit throughput unarmed vs armed-untripped
+        (guarded train step + drain screening, checkpoint cadence pushed
+        past the run). Acceptance: ``armed_over_off >= 0.97``;
+      - NaN recovery: a seeded ``nan_grad`` trip driven down the full
+        ladder (skip_budget=0, straight to rollback) — MTTR is the
+        wall-clock of the recovering step minus the median clean step,
+        steps_lost from the guardrail's own ledger;
+      - bisection probes vs async window size: how blame attribution
+        scales with the in-flight window the rollback has to replay.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu import faults, guardrails
+    from deeplearning4j_tpu.common.env import env as _env
+    from deeplearning4j_tpu.datasets import ArrayDataSetIterator
+    from deeplearning4j_tpu.guardrails import GuardrailPolicy
+    from deeplearning4j_tpu.nn import (
+        InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize import Sgd
+
+    def model():
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Sgd(lr=0.05)).list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+
+    def fit_lane(armed, work=None):
+        m = model()
+        if armed:
+            guardrails.arm(m, GuardrailPolicy(checkpoint_every=10_000),
+                           checkpoint_dir=work)
+        it = ArrayDataSetIterator(x, y, batch_size=16)
+        m.fit(it, epochs=1)                     # compile + warm
+        done = 0
+        t0 = time.perf_counter()
+        while done < steps:
+            for ds in it:
+                m.fit_batch(ds)
+                done += 1
+                if done >= steps:
+                    break
+        rate = steps / (time.perf_counter() - t0)
+        if armed:
+            guardrails.disarm(m)
+        return rate
+
+    work = tempfile.mkdtemp(prefix="bench_guardrails_")
+    try:
+        faults.configure("")
+        off = [fit_lane(False) for _ in range(rounds)]
+        armed = [fit_lane(True, os.path.join(work, "armed"))
+                 for _ in range(rounds)]
+
+        # ---- NaN trip: MTTR + steps lost through the rollback rung ----
+        trip_at, ckpt_every = 11, 5
+        m = model()
+        guard = guardrails.arm(
+            m, GuardrailPolicy(skip_budget=0, clip_retry=False,
+                               checkpoint_every=ckpt_every, warmup_steps=4),
+            checkpoint_dir=os.path.join(work, "mttr"))
+        it = ArrayDataSetIterator(x, y, batch_size=16)
+        m.fit(it, epochs=1)                     # compile + warm
+        faults.configure(f"nan_grad:1@step=={trip_at}", seed=0)
+        clean_times, trip_time = [], None
+        done = 0
+        while trip_time is None:
+            for ds in it:
+                t0 = time.perf_counter()
+                m.fit_batch(ds)
+                dt = time.perf_counter() - t0
+                if guard.rollbacks:
+                    trip_time = dt
+                    break
+                clean_times.append(dt)
+                done += 1
+                if done > 200:                  # safety: should never hit
+                    trip_time = float("nan")
+                    break
+        faults.configure("")
+        clean_step = sorted(clean_times)[len(clean_times) // 2]
+        mttr = max(0.0, trip_time - clean_step)
+        nan_steps_lost = guard.steps_lost
+        guardrails.disarm(m)
+
+        # ---- bisection probe count vs async window size ----
+        probes = {}
+        for win in (1, 4, 8):
+            os.environ["DL4J_TPU_ASYNC_STEPS"] = str(win)
+            _env.reload()
+            try:
+                mw = model()
+                gw = guardrails.arm(
+                    mw, GuardrailPolicy(skip_budget=0, clip_retry=False,
+                                        checkpoint_every=4, warmup_steps=4),
+                    checkpoint_dir=os.path.join(work, f"bisect{win}"))
+                itw = ArrayDataSetIterator(x, y, batch_size=16)
+                faults.configure("nan_grad:1@step==9", seed=0)
+                mw.fit(itw, epochs=5)
+                probes[str(win)] = {
+                    "bisect_probes": gw.last_bisect_probes,
+                    "culprit": (gw.quarantined or [None])[0],
+                }
+                guardrails.disarm(mw)
+            finally:
+                faults.configure("")
+                os.environ.pop("DL4J_TPU_ASYNC_STEPS", None)
+                _env.reload()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        faults.configure("")
+
+    off_s, armed_s = _stats(off), _stats(armed)
+    return {
+        "steps_per_lane": steps,
+        "off_steps_per_sec": off_s,
+        "armed_steps_per_sec": armed_s,
+        "armed_over_off": round(armed_s["median"] / max(off_s["median"],
+                                                        1e-9), 4),
+        "nan_recovery": {
+            "checkpoint_every": ckpt_every,
+            "trip_at_step": trip_at,
+            "mttr_seconds": round(mttr, 4),
+            "clean_step_seconds": round(clean_step, 5),
+            "steps_lost": nan_steps_lost,
+        },
+        "bisect_probes_by_window": probes,
+        "note": "armed_over_off >= 0.97 is the acceptance line: the "
+                "sentinel rides the existing loss fetch, so armed-"
+                "untripped overhead is one f32[4] word per step",
+    }
+
+
 def bench_pipeline(batch=256, n=2048, hw=256, crop=224, epochs=3):
     """Standalone sustained throughput of the native image input path
     (VERDICT r2 #3): staged uint8 [n, hw, hw, 3] -> threaded random-crop /
@@ -2731,6 +2882,18 @@ def main():
             "unit": "x of fault-free throughput",
             "vs_baseline": t["armed_over_off"],
             "faults": t,
+        }))
+        return
+    if mode == "guardrails":
+        t = bench_guardrails(rounds=rounds)
+        print(json.dumps({
+            "metric": "training-guardrails cost (armed-untripped fit "
+                      "throughput vs off + NaN-trip MTTR/steps-lost + "
+                      "bisection probes vs window)",
+            "value": t["armed_over_off"],
+            "unit": "x of unarmed throughput (acceptance >= 0.97)",
+            "vs_baseline": t["nan_recovery"]["mttr_seconds"],
+            "guardrails": t,
         }))
         return
     if mode == "serve":

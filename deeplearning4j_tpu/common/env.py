@@ -90,6 +90,13 @@ class Environment:
     FLIGHT = "DL4J_TPU_FLIGHT"
     FLIGHT_DIR = "DL4J_TPU_FLIGHT_DIR"
     FLIGHT_CAP = "DL4J_TPU_FLIGHT_CAP"
+    # Training guardrails (deeplearning4j_tpu.guardrails): =1 arms the
+    # numeric sentinel + policy ladder on every model's fit loop; the DIR
+    # variant gives the ladder a rollback checkpoint directory (without
+    # it, the ladder ends at clip-retry). Unset = zero-overhead unarmed
+    # fit path (spy-guarded, like MONITORING/FAULTS).
+    GUARDRAILS = "DL4J_TPU_GUARDRAILS"
+    GUARDRAILS_DIR = "DL4J_TPU_GUARDRAILS_DIR"
 
     def __init__(self) -> None:
         self.reload()
@@ -114,6 +121,9 @@ class Environment:
         self.flight_dir = (os.environ.get(self.FLIGHT_DIR)
                            or "").strip() or None
         self.flight_cap = max(1, _int(self.FLIGHT_CAP, 512))
+        self.guardrails = _flag(self.GUARDRAILS)
+        self.guardrails_dir = (os.environ.get(self.GUARDRAILS_DIR)
+                               or "").strip() or None
 
 
 env = Environment()

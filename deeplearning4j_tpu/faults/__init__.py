@@ -37,6 +37,18 @@ Fault classes (the injection points that consume them in parentheses):
                          :class:`PreemptionFault` so the driver dies
                          mid-decode exactly like a real preemption
                          (generation engine step loop, trainer fit loop)
+    ``nan_grad``         NaNs written into the step's feature batch so the
+                         gradients (and loss) go non-finite — the numeric
+                         sentinel's hard-trip drill (fit_batch input path
+                         via :func:`poison_batch`)
+    ``loss_spike``       features scaled by 1e4: a huge-but-usually-finite
+                         loss/gradient spike for the gnorm and z-score
+                         screens (fit_batch input path)
+    ``data_corrupt``     features overwritten with structured finite
+                         garbage — the sneaky corruption that may pass
+                         per-step screens and only derail later steps,
+                         exercising rollback + bisection blame
+                         (fit_batch input path)
 
 Spec grammar (``DL4J_TPU_FAULTS`` env var or :func:`configure`)::
 
@@ -75,7 +87,8 @@ from deeplearning4j_tpu.faults.retry import RetryPolicy  # noqa: F401 (re-export
 
 CLASSES = ("ckpt_io", "ckpt_corrupt", "coord_connect", "collective_delay",
            "worker_crash", "data_io", "infer_crash", "slow_worker",
-           "traffic_spike", "preempt")
+           "traffic_spike", "preempt", "nan_grad", "loss_spike",
+           "data_corrupt")
 
 ENV_SPEC = "DL4J_TPU_FAULTS"
 ENV_SEED = "DL4J_TPU_FAULTS_SEED"
@@ -282,6 +295,52 @@ def reset() -> None:
     configure(None)
 
 
+def _poison_features(x, mode: str):
+    """Return a poisoned copy of a features entry (host numpy). Multi-input
+    lists/dicts (the ComputationGraph shape) poison their first float
+    entry; integer features (token ids) are left alone — there is nothing
+    numeric to corrupt before the embedding lookup."""
+    import numpy as np
+
+    if isinstance(x, dict):
+        for k, v in x.items():
+            p = _poison_features(v, mode)
+            if p is not v:
+                return {**x, k: p}
+        return x
+    if isinstance(x, (list, tuple)):
+        for i, v in enumerate(x):
+            p = _poison_features(v, mode)
+            if p is not v:
+                out = list(x)
+                out[i] = p
+                return out
+        return x
+    a = np.array(x, copy=True)
+    if not np.issubdtype(a.dtype, np.floating) or a.size == 0:
+        return x
+    flat = a.reshape(-1)
+    if mode == "nan_grad":
+        flat[:: max(1, a.size // 4)] = np.nan
+    elif mode == "loss_spike":
+        flat *= 1e4
+    else:  # data_corrupt: large, structured, FINITE garbage
+        flat[:] = np.sign(flat + 0.5) * (np.abs(flat) * 97.0 + 31.0)
+    return a
+
+
+def poison_batch(plan: FaultPlan, x, y, step: int):
+    """Train-step input-path injection for the numeric fault classes
+    (``nan_grad`` / ``loss_spike`` / ``data_corrupt``). Called by the fit
+    loops right after unpacking a batch, BEFORE the guardrail's replay
+    ring records it — so a rollback replays the poisoned bytes exactly
+    and the bisection can name them. Returns (x, y)."""
+    for cls in ("nan_grad", "loss_spike", "data_corrupt"):
+        if plan.fires(cls, step=step):
+            x = _poison_features(x, cls)
+    return x, y
+
+
 @contextlib.contextmanager
 def injected(spec: str, seed: int = 0, delay_s: float = 0.05):
     """Scoped programmatic injection::
@@ -307,5 +366,6 @@ __all__ = [
     "CLASSES", "FaultPlan", "FaultRule", "RetryPolicy",
     "InjectedFault", "CheckpointIOFault", "DataReadFault",
     "CoordinatorConnectFault", "InferenceWorkerCrash", "PreemptionFault",
-    "active", "configure", "injected", "parse_spec", "reset",
+    "active", "configure", "injected", "parse_spec", "poison_batch",
+    "reset",
 ]

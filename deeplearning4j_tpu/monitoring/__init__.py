@@ -54,6 +54,7 @@ _generate_mon = None
 _quantize_mon = None
 _tenant_mon = None
 _slo_mon = None
+_guardrail_mon = None
 
 
 def registry() -> MetricsRegistry:
@@ -82,13 +83,13 @@ def reset() -> None:
     global _REGISTRY, _tracer, _enabled
     global _fit_mon, _serving_mon, _localsgd_mon, _ckpt_mon, _import_mon
     global _recovery_mon, _compile_mon, _generate_mon, _quantize_mon
-    global _tenant_mon, _slo_mon
+    global _tenant_mon, _slo_mon, _guardrail_mon
     _REGISTRY = MetricsRegistry()
     _tracer = None
     _enabled = env.monitoring
     _fit_mon = _serving_mon = _localsgd_mon = _ckpt_mon = None
     _import_mon = _recovery_mon = _compile_mon = _generate_mon = None
-    _quantize_mon = _tenant_mon = _slo_mon = None
+    _quantize_mon = _tenant_mon = _slo_mon = _guardrail_mon = None
     flight.reset()
 
 
@@ -307,6 +308,34 @@ class _RecoveryMonitor:
             labels=("cls",))
 
 
+class _GuardrailMonitor:
+    """Training-guardrail instruments (deeplearning4j_tpu.guardrails):
+    sentinel trips by kind, policy-ladder actions, steps lost to skips
+    and quarantines, bisection probe cost, and the last observed global
+    gradient norm — the ``dl4j_guardrail_*`` runbook tier documented in
+    docs/fault_tolerance.md."""
+
+    def __init__(self, reg: MetricsRegistry):
+        self.reg = reg
+        self.trips = reg.counter(
+            "dl4j_guardrail_trips_total",
+            "Sentinel trips observed at delivery, by trip kind",
+            labels=("kind",))
+        self.actions = reg.counter(
+            "dl4j_guardrail_actions_total",
+            "Policy-ladder actions taken on sentinel trips",
+            labels=("action",))
+        self.steps_lost = reg.counter(
+            "dl4j_guardrail_steps_lost_total",
+            "Train steps discarded by the guardrail (skips + quarantines)")
+        self.bisect_probes = reg.counter(
+            "dl4j_guardrail_bisect_probes_total",
+            "Replay dispatches spent bisecting for culprit batches")
+        self.grad_norm = reg.gauge(
+            "dl4j_guardrail_grad_norm",
+            "Last pre-clip global gradient norm seen by the sentinel")
+
+
 class _CompileMonitor:
     """XLA compile-time instruments (monitoring/compile.py bridges
     jax.monitoring events here): every backend compile lands in
@@ -517,6 +546,10 @@ def slo_monitor() -> Optional[_SloMonitor]:
     return _bundle("_slo_mon", _SloMonitor)
 
 
+def guardrail_monitor() -> Optional[_GuardrailMonitor]:
+    return _bundle("_guardrail_mon", _GuardrailMonitor)
+
+
 from deeplearning4j_tpu.monitoring.listener import MetricsListener  # noqa: E402 (cycle: listener imports this module)
 from deeplearning4j_tpu.monitoring.context import (  # noqa: E402 (cycle: context imports this module)
     RequestTrace, RequestTracer,
@@ -531,5 +564,5 @@ __all__ = [
     "fit_monitor", "serving_monitor", "localsgd_monitor",
     "checkpoint_monitor", "import_monitor", "recovery_monitor",
     "compile_monitor", "generate_monitor", "quantize_monitor",
-    "tenant_monitor", "slo_monitor",
+    "tenant_monitor", "slo_monitor", "guardrail_monitor",
 ]

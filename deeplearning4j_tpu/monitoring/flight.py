@@ -35,7 +35,8 @@ from deeplearning4j_tpu.common.env import env
 
 #: Event kinds that auto-dump a postmortem bundle when a dump dir is set.
 TRIGGER_KINDS = frozenset(
-    {"worker_crash", "gateway_error", "slo_burn", "slo_shed", "preempt"})
+    {"worker_crash", "gateway_error", "slo_burn", "slo_shed", "preempt",
+     "numeric_trip"})
 
 
 class FlightRecorder:

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu import faults, guardrails, monitoring
 from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.nn.conf.builders import ComputationGraphConfiguration
@@ -388,13 +388,18 @@ class ComputationGraph:
                 loss = loss + v.layer.regularization(params[name])
         return loss, new_state
 
-    def _make_train_step(self):
+    def _make_train_step(self, guarded: bool = False,
+                         clip_active: bool = True):
         updaters = self._updaters
         max_norm = self.conf.max_grad_norm
+        conf_clipnorm = float(getattr(self.conf.updater, "clipnorm", 0.0)
+                              or 0.0)
+        if guarded:
+            from deeplearning4j_tpu.guardrails import sentinel as _sentinel
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, opt_state, step, inputs, labels, key, masks,
-                       labels_masks=None):
+                       labels_masks=None, ctrl=None):
             def loss_fn(p):
                 cp, ci = self._cast_in(p, inputs)
                 loss, new_state = self._loss(cp, state, ci, labels, key, masks,
@@ -402,17 +407,37 @@ class ComputationGraph:
                 return loss.astype(jnp.float32), new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if guarded:
+                # screen the RAW grads (NaN survives any clip scale, so the
+                # clips below cannot launder a non-finite gradient)
+                grads, word = _sentinel.screen(grads, loss, ctrl,
+                                               with_clip=clip_active)
             if max_norm > 0:
                 grads = global_norm_clip(grads, max_norm)
+            if conf_clipnorm > 0:
+                grads = global_norm_clip(grads, conf_clipnorm)
             new_params, new_opt = {}, {}
             for name, p in params.items():
-                upd, ost = updaters[name].update(grads[name], opt_state[name], p, step)
+                g = grads[name]
+                u = updaters[name]
+                # per-vertex updater override: clip only that subtree
+                ucn = float(getattr(u, "clipnorm", 0.0) or 0.0)
+                if ucn > 0 and u is not self.conf.updater:
+                    g = global_norm_clip(g, ucn)
+                upd, ost = u.update(g, opt_state[name], p, step)
                 new_params[name] = jax.tree_util.tree_map(lambda a, d: a - d, p, upd)
                 new_opt[name] = ost
             # carry forward unchanged state entries
             for k, v in state.items():
                 new_state.setdefault(k, v)
-            return new_params, new_state, new_opt, loss
+            if not guarded:
+                return new_params, new_state, new_opt, loss
+            # tripped step: keep the old params/opt/state ON DEVICE
+            ok = word[_sentinel.WORD_OK] > 0
+            new_params = _sentinel.tree_select(ok, new_params, params)
+            new_opt = _sentinel.tree_select(ok, new_opt, opt_state)
+            new_state = _sentinel.tree_select(ok, new_state, state)
+            return new_params, new_state, new_opt, loss, word
 
         return train_step
 
@@ -492,6 +517,12 @@ class ComputationGraph:
                 "this network is an int8 inference view (quantize()); "
                 "train the original f32 network instead")
         x, y, mask, label_mask = _unpack(ds)
+        plan = faults.active()
+        if plan is not None:
+            # input-path injection (nan_grad/loss_spike/data_corrupt): the
+            # batch is poisoned BEFORE the replay ring sees it, so retries
+            # replay the same poisoned bytes deterministically
+            x, y = faults.poison_batch(plan, x, y, step=self.step_count)
         if env.pad_tail and not isinstance(y, (list, tuple, dict)):
             # pad partial epoch tails up to a pow2 bucket (loss-exact via
             # label-mask zeroing); multi-input x pads per entry, but a
@@ -507,6 +538,16 @@ class ComputationGraph:
         inputs = self._as_input_dict(x)
         labels = self._as_label_dict(y)
         labels_masks = self._labels_masks_for(mask, label_mask)
+        window = get_window(self)
+        mon = monitoring.fit_monitor()
+        guard = guardrails.get_guard(self)
+        if guard is not None:
+            result = guard.step(
+                self, (inputs, labels),
+                (None if mask is None else [jnp.asarray(mask)], labels_masks),
+                window, mon)
+            self.step_count += 1
+            return result
         fn = self._jit_cache.get("train")
         if fn is None:
             fn = self._make_train_step()
@@ -518,8 +559,6 @@ class ComputationGraph:
                 jnp.asarray(self.step_count, jnp.int32), inputs, labels,
                 self._next_key(),
                 None if mask is None else [jnp.asarray(mask)], labels_masks)
-        window = get_window(self)
-        mon = monitoring.fit_monitor()
         if mon is None:
             # hot path: monitoring off means NO registry/tracer calls here
             self.params, self.state, self.opt_state, loss = fn(*args)
@@ -537,7 +576,13 @@ class ComputationGraph:
         else:
             with mon.phase("dispatch"):
                 self.params, self.state, self.opt_state, loss = fn(*args)
-            result = window.submit(loss)  # drains oldest once over capacity
+            try:
+                result = window.submit(loss)  # drains oldest once over capacity
+            except BaseException:
+                # drain error for an older step: this step is queued, its id
+                # is consumed either way (see deliver_score)
+                self.step_count += 1
+                raise
         self.step_count += 1
         return result
 

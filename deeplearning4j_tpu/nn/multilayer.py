@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import monitoring
+from deeplearning4j_tpu import faults, guardrails, monitoring
 from deeplearning4j_tpu.common.dtypes import BF16, FLOAT32
 from deeplearning4j_tpu.common.env import env
 from deeplearning4j_tpu.eval.evaluation import Evaluation
@@ -256,18 +256,30 @@ class MultiLayerNetwork:
     def _apply_updaters(self, grads, params, opt_state, step):
         if self.conf.max_grad_norm > 0:
             grads = global_norm_clip(grads, self.conf.max_grad_norm)
+        cn = float(getattr(self.conf.updater, "clipnorm", 0.0) or 0.0)
+        if cn > 0:
+            grads = global_norm_clip(grads, cn)
         new_params, new_opt = [], []
         for i, u in enumerate(self._updaters):
-            upd, ost = u.update(grads[i], opt_state[i], params[i], step)
+            g = grads[i]
+            # per-layer updater override: clip only that layer's subtree
+            ucn = float(getattr(u, "clipnorm", 0.0) or 0.0)
+            if ucn > 0 and u is not self.conf.updater:
+                g = global_norm_clip(g, ucn)
+            upd, ost = u.update(g, opt_state[i], params[i], step)
             new_params.append(jax.tree_util.tree_map(lambda p, d: p - d,
                                                      params[i], upd))
             new_opt.append(ost)
         return new_params, new_opt
 
-    def _make_train_step(self):
+    def _make_train_step(self, guarded: bool = False,
+                         clip_active: bool = True):
+        if guarded:
+            from deeplearning4j_tpu.guardrails import sentinel as _sentinel
+
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, state, opt_state, step, x, y, key, mask,
-                       label_mask=None):
+                       label_mask=None, ctrl=None):
             def loss_fn(p):
                 cp = _tree_cast(p, self._policy.compute_dtype)
                 cx = x if not jnp.issubdtype(x.dtype, jnp.floating) else x.astype(
@@ -277,8 +289,23 @@ class MultiLayerNetwork:
                 return loss.astype(jnp.float32), new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            new_params, new_opt = self._apply_updaters(grads, params, opt_state, step)
-            return new_params, new_states, new_opt, loss
+            if not guarded:
+                new_params, new_opt = self._apply_updaters(grads, params,
+                                                           opt_state, step)
+                return new_params, new_states, new_opt, loss
+            # screen the RAW grads (NaN * clip_scale is still NaN, so the
+            # clip below cannot launder a non-finite gradient past the word)
+            grads, word = _sentinel.screen(grads, loss, ctrl,
+                                           with_clip=clip_active)
+            new_params, new_opt = self._apply_updaters(grads, params,
+                                                       opt_state, step)
+            # a tripped step keeps the old params/opt/state ON DEVICE: the
+            # bad update never materializes host-side or in checkpoints
+            ok = word[_sentinel.WORD_OK] > 0
+            new_params = _sentinel.tree_select(ok, new_params, params)
+            new_opt = _sentinel.tree_select(ok, new_opt, opt_state)
+            new_states = _sentinel.tree_select(ok, new_states, state)
+            return new_params, new_states, new_opt, loss, word
 
         return train_step
 
@@ -459,6 +486,12 @@ class MultiLayerNetwork:
                 "train the original f32 network instead")
         x, y, mask, label_mask = _unpack(ds)
         label_mask = _single_mask(label_mask)
+        plan = faults.active()
+        if plan is not None:
+            # input-path injection (nan_grad/loss_spike/data_corrupt): the
+            # batch is poisoned BEFORE the replay ring sees it, so retries
+            # replay the same poisoned bytes deterministically
+            x, y = faults.poison_batch(plan, x, y, step=self.step_count)
         if (self.conf.tbptt_fwd_length > 0 and np.ndim(x) == 3
                 and np.shape(x)[1] > self.conf.tbptt_fwd_length):
             return self._fit_tbptt(x, y, mask, label_mask)
@@ -472,6 +505,17 @@ class MultiLayerNetwork:
             elif b < max_b and self._tail_padding_ok():
                 x, y, mask, label_mask = pad_tail_batch(
                     x, y, mask, label_mask, max_b)
+        window = get_window(self)
+        mon = monitoring.fit_monitor()
+        guard = guardrails.get_guard(self)
+        if guard is not None:
+            result = guard.step(
+                self, (jnp.asarray(x), jnp.asarray(y)),
+                (None if mask is None else jnp.asarray(mask),
+                 None if label_mask is None else jnp.asarray(label_mask)),
+                window, mon)
+            self.step_count += 1
+            return result
         step_fn = self._jit_cache.get("train")
         if step_fn is None:
             step_fn = self._make_train_step()
@@ -482,8 +526,6 @@ class MultiLayerNetwork:
                 jnp.asarray(y), key,
                 None if mask is None else jnp.asarray(mask),
                 None if label_mask is None else jnp.asarray(label_mask))
-        window = get_window(self)
-        mon = monitoring.fit_monitor()
         if mon is None:
             # hot path: monitoring off means NO registry/tracer calls here
             self.params, self.state, self.opt_state, loss = step_fn(*args)
@@ -501,7 +543,13 @@ class MultiLayerNetwork:
         else:
             with mon.phase("dispatch"):
                 self.params, self.state, self.opt_state, loss = step_fn(*args)
-            result = window.submit(loss)  # drains oldest once over capacity
+            try:
+                result = window.submit(loss)  # drains oldest once over capacity
+            except BaseException:
+                # drain error for an older step: this step is queued, its id
+                # is consumed either way (see deliver_score)
+                self.step_count += 1
+                raise
         self.step_count += 1
         return result
 

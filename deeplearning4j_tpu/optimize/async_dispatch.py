@@ -54,17 +54,24 @@ class AsyncStepError(RuntimeError):
     it belongs to (not the step the host had reached when it surfaced).
     ``trace_id`` names the request trace that DISPATCHED the step (ambient
     :func:`monitoring.context.bind` at submit time), so a deferred failure
-    is still attributable to the window that caused it."""
+    is still attributable to the window that caused it. Guarded steps
+    (deeplearning4j_tpu.guardrails) additionally carry ``sentinel`` — the
+    tripping step's [ok, gnorm, loss, z] health word."""
 
     def __init__(self, step: int, epoch: int, cause: BaseException,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None, sentinel=None):
+        sentinel = (None if sentinel is None
+                    else [float(v) for v in sentinel])
         msg = f"async train step {step} (epoch {epoch}) failed: {cause}"
+        if sentinel is not None:
+            msg += f" [sentinel {[round(v, 4) for v in sentinel]}]"
         if trace_id:
             msg += f" [trace {trace_id}]"
         super().__init__(msg)
         self.step = step
         self.epoch = epoch
         self.trace_id = trace_id
+        self.sentinel = sentinel
         self.__cause__ = cause
 
 
@@ -195,30 +202,65 @@ class AsyncScoreWindow:
     def __len__(self) -> int:
         return len(self._pending)
 
-    def submit(self, loss) -> ScoreHandle:
+    def submit(self, loss, word=None, guard=None) -> ScoreHandle:
         """Register one dispatched step's on-device loss; returns its lazy
-        handle. Called with the model's PRE-increment step/epoch counters."""
+        handle. Called with the model's PRE-increment step/epoch counters.
+        Guarded steps (deeplearning4j_tpu.guardrails) also carry their
+        on-device sentinel ``word`` and the ``guard`` that screens it at
+        drain — the word's loss lane replaces the bare loss fetch, so the
+        screen costs no extra host sync."""
         m = self.model
         handle = ScoreHandle(self, m.step_count, m.epoch_count)
         # snapshot: set_listeners() between dispatch and drain must not
         # retroactively change who observes this iteration
-        self._pending.append((handle, loss, tuple(m.listeners)))
+        self._pending.append((handle, loss, tuple(m.listeners), word, guard))
         while len(self._pending) > self.max_in_flight:
             self._drain_one()
         return handle
 
+    def take_pending(self):
+        """Remove and return every in-flight entry (guardrails rollback:
+        a checkpoint restore erases the device-side effects of in-flight
+        steps, so the guard re-resolves their handles host-side from the
+        replayed window and re-queues them for FIFO delivery)."""
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def requeue(self, handle, listeners, word, guard) -> None:
+        """Re-queue a taken entry with a host-side resolution in place of
+        its (now stale) device arrays; delivered by the normal FIFO drain."""
+        self._pending.append((handle, None, listeners, word, guard))
+
     def _drain_one(self) -> None:
-        handle, loss, listeners = self._pending.popleft()
+        handle, loss, listeners, word, guard = self._pending.popleft()
         mon = monitoring.fit_monitor()
         try:
-            if mon is None:
+            if guard is not None:
+                from deeplearning4j_tpu import guardrails
+
+                if isinstance(word, guardrails._Resolved):
+                    # a rollback already re-resolved this step host-side
+                    value = word.value
+                elif mon is None:
+                    value = guard.deliver(self.model, handle.step,
+                                          handle.epoch,
+                                          guardrails._fetch_word(word), self)
+                else:
+                    with mon.phase("drain"):
+                        value = guard.deliver(self.model, handle.step,
+                                              handle.epoch,
+                                              guardrails._fetch_word(word),
+                                              self)
+            elif mon is None:
                 value = _fetch_scalar(loss)
             else:
                 with mon.phase("drain"):
                     value = _fetch_scalar(loss)
         except Exception as e:  # surfaced with the step it belongs to
             handle._error = AsyncStepError(handle.step, handle.epoch, e,
-                                           trace_id=handle.trace_id)
+                                           trace_id=handle.trace_id,
+                                           sentinel=getattr(e, "word", None))
             raise handle._error
         handle._value = value
         self.model._score_value = value
@@ -286,7 +328,15 @@ def deliver_score(model, loss, window: Optional[AsyncScoreWindow],
     ``_score_value``, run listeners (timed when ``mon`` is active). Async:
     submit to the window. Caller increments ``step_count`` afterwards."""
     if window is not None:
-        return window.submit(loss)
+        try:
+            return window.submit(loss)
+        except BaseException:
+            # the handle is queued before the window drains, so an error
+            # surfacing here belongs to an OLDER step — the current step is
+            # dispatched and queued and must still consume its id, or the
+            # next fit_batch would re-dispatch under the same step number
+            model.step_count += 1
+            raise
     value = _fetch_scalar(loss)
     model._score_value = value
     if mon is None:

@@ -42,9 +42,15 @@ def _zeros_like(params):
 
 @dataclasses.dataclass(frozen=True)
 class Updater:
-    """IUpdater analog. ``lr`` may be a float or a Schedule."""
+    """IUpdater analog. ``lr`` may be a float or a Schedule.
+
+    ``clipnorm`` > 0 clips the gradient tree to that global L2 norm before
+    this updater's math runs (GradientNormalization.ClipL2PerLayer analog);
+    keyword-only so subclass positional signatures stay stable.
+    """
 
     lr: object = 1e-3
+    clipnorm: float = dataclasses.field(default=0.0, kw_only=True)
 
     def _lr(self, step):
         return resolve_schedule(self.lr)(step)

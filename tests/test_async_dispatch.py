@@ -250,6 +250,37 @@ class TestDrainErrors:
             h1.value()
         assert np.isfinite(float(h2))
 
+    def test_drain_error_does_not_poison_later_deliveries(self, monkeypatch):
+        """Regression: the step being SUBMITTED when an older step's drain
+        error surfaces is already queued — its id must be consumed, or the
+        next fit_batch re-dispatches under the same step number and
+        listeners see a duplicate iteration. After one failed step, every
+        other iteration fires its listener exactly once, in order."""
+        net, lst = _model(), CollectScoresListener()
+        net.set_listeners(lst)
+        x, y = _data()
+        real = async_dispatch._fetch_scalar
+
+        def failing_fetch(arr):
+            failing_fetch.calls += 1
+            if failing_fetch.calls == 2:     # second drained step (step 1)
+                raise FloatingPointError("injected device failure")
+            return real(arr)
+
+        failing_fetch.calls = 0
+        monkeypatch.setattr(async_dispatch, "_fetch_scalar", failing_fetch)
+        _async(monkeypatch, 2)
+        errors = []
+        for _ in range(8):
+            try:
+                net.fit_batch((x, y))
+            except AsyncStepError as e:
+                errors.append(e)
+        net._score_window.drain()
+        assert [e.step for e in errors] == [1]
+        assert net.step_count == 8
+        assert [i for i, _ in lst.scores] == [i for i in range(8) if i != 1]
+
     def test_fit_drains_at_epoch_end_before_epoch_listeners(self):
         events = []
 

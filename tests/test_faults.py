@@ -104,6 +104,57 @@ class TestSpecGrammar:
                 False, False, True, True, True]
 
 
+# ------------------------------------------------- numeric (train input)
+class TestNumericFaults:
+    """The guardrail-facing fault classes: poisoned train-step inputs."""
+
+    def test_numeric_classes_parse(self):
+        rules = parse_spec("nan_grad:1@step>20;loss_spike:0.5;"
+                           "data_corrupt:1@step==3")
+        assert [r.cls for r in rules] == [
+            "nan_grad", "loss_spike", "data_corrupt"]
+        for cls in ("nan_grad", "loss_spike", "data_corrupt"):
+            assert cls in faults.CLASSES
+
+    def test_poison_batch_fires_on_step_predicate_only(self):
+        x = np.ones((4, 3), dtype=np.float32)
+        y = np.ones((4, 2), dtype=np.float32)
+        with faults.injected("nan_grad:1@step==7") as plan:
+            px, py = faults.poison_batch(plan, x, y, step=6)
+            assert px is x and py is y          # no rule fired: no copy
+            px, _ = faults.poison_batch(plan, x, y, step=7)
+        assert plan.injected["nan_grad"] == 1
+        assert np.isnan(px).any()
+        assert not np.isnan(x).any()            # original untouched
+        assert 0.0 < np.isfinite(px).mean() < 1.0
+
+    def test_poison_modes_are_deterministic(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        nan1 = faults._poison_features(x, "nan_grad")
+        nan2 = faults._poison_features(x, "nan_grad")
+        np.testing.assert_array_equal(nan1, nan2)   # NaN == NaN bytewise
+        assert nan1.tobytes() == nan2.tobytes()
+        spike = faults._poison_features(x, "loss_spike")
+        np.testing.assert_allclose(spike, x * 1e4)
+        corrupt = faults._poison_features(x, "data_corrupt")
+        assert np.isfinite(corrupt).all()           # finite garbage
+        assert np.abs(corrupt).min() >= 31.0
+
+    def test_poison_skips_integer_features(self):
+        tokens = np.arange(12, dtype=np.int32).reshape(3, 4)
+        assert faults._poison_features(tokens, "nan_grad") is tokens
+
+    def test_poison_multi_input_touches_first_float_entry(self):
+        tokens = np.arange(6, dtype=np.int32)
+        feats = np.ones((2, 3), dtype=np.float32)
+        other = np.ones((2, 2), dtype=np.float32)
+        out = faults._poison_features([tokens, feats, other], "nan_grad")
+        assert out[0] is tokens
+        assert np.isnan(out[1]).any()
+        assert out[2] is other                      # only the first float
+
+
 # ---------------------------------------------------------------- retry
 class TestRetryPolicy:
     def test_retry_then_succeed_records_recovery(self):
